@@ -1,0 +1,34 @@
+"""mxnet_tpu: a TPU-native deep learning framework.
+
+A brand-new framework with the capabilities of pre-Gluon MXNet (imperative
+NDArray + symbolic Symbol/Executor programming, KVStore data-parallel
+training, RecordIO data pipelines), rebuilt idiomatically on JAX/XLA:
+mshadow kernels are XLA lowerings, ``Symbol.bind()`` compiles the graph to
+one HLO module, the threaded dependency engine maps to XLA async dispatch,
+and ps-lite push/pull becomes ICI/DCN collectives.
+
+See SURVEY.md at the repo root for the structural analysis of the reference
+this build follows.
+"""
+import jax as _jax
+
+# The reference supports float64 NDArrays (mshadow DType includes double);
+# JAX gates 64-bit dtypes behind x64.  All our constructors pass explicit
+# dtypes (float32 default), so enabling this does not change defaults.
+_jax.config.update("jax_enable_x64", True)
+
+from . import base
+from .base import MXNetError
+from . import context
+from .context import Context, cpu, tpu, gpu, current_context
+from . import ops
+from . import ndarray
+from . import ndarray as nd
+from . import random
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "MXNetError", "Context", "cpu", "tpu", "gpu", "current_context",
+    "nd", "ndarray", "random", "ops",
+]

@@ -1,0 +1,100 @@
+"""Base utilities: errors, registries, string helpers.
+
+TPU-native analog of the reference's ``python/mxnet/base.py`` (ctypes lib
+loading, ``MXNetError``, ``check_call``) and dmlc-core's registry machinery
+(``dmlc/registry.h``).  There is no FFI boundary here — the "C API" layer of
+the reference (``src/c_api/c_api.cc``) is unnecessary when the runtime is
+XLA — so this module keeps only the error type, the registry pattern, and
+doc/type helpers.
+"""
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, Dict, Generic, List, Optional, TypeVar
+
+__all__ = [
+    "MXNetError",
+    "MXTPUError",
+    "Registry",
+    "string_types",
+    "numeric_types",
+    "classproperty",
+]
+
+
+class MXNetError(RuntimeError):
+    """Error raised by the framework (analog of reference ``base.py:MXNetError``)."""
+
+
+# Alias under the new framework's own name.
+MXTPUError = MXNetError
+
+string_types = (str,)
+numeric_types = (float, int)
+
+T = TypeVar("T")
+
+
+class Registry(Generic[T]):
+    """A named registry, analog of ``dmlc::Registry`` (dmlc/registry.h).
+
+    Entries are registered under a unique name, optionally with aliases.
+    Lookup is case-sensitive first, then case-insensitive (matching the
+    lenient lookup the reference's Python layers do for optimizers etc.).
+    """
+
+    def __init__(self, name: str):
+        self.name = name
+        self._entries: Dict[str, T] = {}
+
+    def register(self, entry: T, name: Optional[str] = None, aliases: Optional[List[str]] = None) -> T:
+        key = name if name is not None else getattr(entry, "__name__", None)
+        if key is None:
+            raise ValueError("registry entry needs a name")
+        if key in self._entries:
+            raise ValueError(f"{self.name} registry already has an entry '{key}'")
+        self._entries[key] = entry
+        for a in aliases or []:
+            self._entries[a] = entry
+        return entry
+
+    def get(self, name: str) -> T:
+        if name in self._entries:
+            return self._entries[name]
+        lowered = {k.lower(): v for k, v in self._entries.items()}
+        if name.lower() in lowered:
+            return lowered[name.lower()]
+        raise KeyError(f"{self.name} registry has no entry '{name}'. "
+                       f"Known: {sorted(self._entries)}")
+
+    def __contains__(self, name: str) -> bool:
+        try:
+            self.get(name)
+            return True
+        except KeyError:
+            return False
+
+    def list(self) -> List[str]:
+        return sorted(self._entries)
+
+    def items(self):
+        return self._entries.items()
+
+
+class classproperty:
+    """Minimal read-only class property used by a few registries."""
+
+    def __init__(self, fget: Callable[[Any], Any]):
+        self.fget = fget
+
+    def __get__(self, obj, owner):
+        return self.fget(owner)
+
+
+_SNAKE_RE1 = re.compile(r"(.)([A-Z][a-z]+)")
+_SNAKE_RE2 = re.compile(r"([a-z0-9])([A-Z])")
+
+
+def camel_to_snake(name: str) -> str:
+    s = _SNAKE_RE1.sub(r"\1_\2", name)
+    return _SNAKE_RE2.sub(r"\1_\2", s).lower()

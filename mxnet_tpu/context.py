@@ -1,0 +1,147 @@
+"""Device context, analog of reference ``python/mxnet/context.py:1-126``.
+
+The reference models devices as ``Context(device_type, device_id)`` with a
+thread-local default stack usable as a ``with`` block.  Here a context
+resolves to a concrete :class:`jax.Device`.  ``tpu`` replaces the
+reference's ``gpu``; ``gpu`` is kept as an alias for source compatibility
+with reference-era scripts (it resolves to the accelerator backend).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import jax
+
+from .base import MXNetError
+
+__all__ = ["Context", "cpu", "tpu", "gpu", "current_context", "num_devices", "default_ctx"]
+
+
+class Context:
+    """Device context.
+
+    Parameters
+    ----------
+    device_type : str
+        'cpu', 'tpu' (or 'gpu', alias for the accelerator backend).
+    device_id : int
+        Ordinal of the device within its backend.
+    """
+
+    _default_ctx = threading.local()
+
+    devtype2mask = {"cpu": 1, "tpu": 2, "gpu": 2, "cpu_pinned": 3}
+    devmask2type = {1: "cpu", 2: "tpu", 3: "cpu_pinned"}
+
+    def __init__(self, device_type: "str | Context" = "tpu", device_id: int = 0):
+        if isinstance(device_type, Context):
+            self.device_type: str = device_type.device_type
+            self.device_id: int = device_type.device_id
+        else:
+            self.device_type = device_type
+            self.device_id = device_id
+        self._old_ctx: Optional[Context] = None
+
+    @property
+    def device_typeid(self) -> int:
+        return self.devtype2mask[self.device_type]
+
+    def _accelerator_platform(self) -> Optional[str]:
+        """Name of the non-cpu platform if one is live, else None."""
+        for d in jax.devices():
+            if d.platform != "cpu":
+                return d.platform
+        return None
+
+    @property
+    def jax_device(self) -> jax.Device:
+        """Resolve to a concrete jax.Device (raises MXNetError if absent)."""
+        dt = self.device_type
+        if dt in ("tpu", "gpu"):
+            platform = self._accelerator_platform()
+            if platform is None:
+                # No accelerator present (e.g. CPU-only test mesh): fall back
+                # to cpu devices so ctx lists like [tpu(0), tpu(1)] still map
+                # onto the virtual device mesh.
+                platform = "cpu"
+            devices = [d for d in jax.devices() if d.platform == platform]
+        elif dt in ("cpu", "cpu_pinned"):
+            try:
+                devices = jax.devices("cpu")
+            except RuntimeError:
+                # Backend without a cpu client (axon tunnel): treat device 0
+                # of the default backend as host memory stand-in.
+                devices = jax.devices()
+        else:
+            raise MXNetError(f"unknown device type {dt}")
+        if self.device_id >= len(devices):
+            raise MXNetError(
+                f"{self} requested but only {len(devices)} {dt} device(s) present")
+        return devices[self.device_id]
+
+    def __eq__(self, other) -> bool:
+        return (isinstance(other, Context)
+                and self.device_type == other.device_type
+                and self.device_id == other.device_id)
+
+    def __hash__(self):
+        return hash((self.device_type, self.device_id))
+
+    def __str__(self):
+        return f"{self.device_type}({self.device_id})"
+
+    __repr__ = __str__
+
+    def __enter__(self):
+        if not hasattr(Context._default_ctx, "value"):
+            Context._default_ctx.value = Context("cpu", 0)
+        self._old_ctx = Context._default_ctx.value
+        Context._default_ctx.value = self
+        return self
+
+    def __exit__(self, exc_type, exc_value, traceback):
+        Context._default_ctx.value = self._old_ctx
+
+
+def cpu(device_id: int = 0) -> Context:
+    """Return a CPU context (reference ``context.py:cpu``)."""
+    return Context("cpu", device_id)
+
+
+def tpu(device_id: int = 0) -> Context:
+    """Return a TPU context — the accelerator analog of reference ``gpu()``."""
+    return Context("tpu", device_id)
+
+
+def gpu(device_id: int = 0) -> Context:
+    """Alias of :func:`tpu` kept for reference-script compatibility."""
+    return Context("tpu", device_id)
+
+
+def current_context() -> Context:
+    """Return the current context (reference ``context.py:current_context``)."""
+    if not hasattr(Context._default_ctx, "value"):
+        Context._default_ctx.value = Context("cpu", 0)
+    return Context._default_ctx.value
+
+
+def default_ctx() -> Context:
+    """Best single-device context for this process: tpu if present else cpu."""
+    for d in jax.devices():
+        if d.platform != "cpu":
+            return Context("tpu", 0)
+    return Context("cpu", 0)
+
+
+def num_devices(device_type: str = "tpu") -> int:
+    """Number of visible devices of the given type."""
+    if device_type in ("tpu", "gpu"):
+        n = len([d for d in jax.devices() if d.platform != "cpu"])
+        if n == 0:
+            n = len(jax.devices())
+        return n
+    try:
+        return len(jax.devices("cpu"))
+    except RuntimeError:
+        return 0

@@ -1,0 +1,527 @@
+"""NDArray: the imperative tensor API.
+
+TPU-native rebuild of the reference NDArray (``include/mxnet/ndarray.h:31-355``,
+``src/ndarray/ndarray.cc``).  Design mapping:
+
+* The reference NDArray is a ref-counted ``Chunk`` (storage handle + engine
+  variable) with zero-copy ``Slice/At/Reshape`` views (``ndarray.h:227-261``,
+  ``290-346``).  Here :class:`_Chunk` holds a ``jax.Array``; views record a
+  contiguous flat range into the chunk, so writes through any view are seen
+  by all aliases — the user-visible mutation semantics survive even though
+  the underlying buffers are immutable (each write swaps the chunk's array
+  for a functionally-updated one).
+* The reference pushes every mutation through the dependency engine and
+  returns immediately (``ndarray.cc:96-219``); JAX's async dispatch plays
+  that role.  ``wait_to_read`` ≡ ``block_until_ready``
+  (``ndarray.h:94-97`` → ``Engine::WaitForVar``).
+* ``MXNET_REGISTER_NDARRAY_FUN`` module functions (``ndarray.h:482-660``)
+  are generated from the op registry at import time, like the reference's
+  ``_init_ndarray_module`` (``python/mxnet/ndarray.py``).
+"""
+from __future__ import annotations
+
+import struct
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .base import MXNetError
+from .context import Context, current_context, default_ctx
+from .ops.registry import OP_REGISTRY, OpContext, get_op
+
+__all__ = [
+    "NDArray", "zeros", "ones", "full", "empty", "array", "arange",
+    "concatenate", "save", "load", "imperative_invoke", "waitall",
+]
+
+_DTYPE_ALIASES = {
+    "float32": np.float32, "float64": np.float64, "float16": np.float16,
+    "bfloat16": jnp.bfloat16, "uint8": np.uint8, "int32": np.int32,
+    "int64": np.int64,
+}
+
+
+def _as_dtype(dtype) -> np.dtype:
+    if dtype is None:
+        return np.dtype(np.float32)
+    if isinstance(dtype, str):
+        dtype = _DTYPE_ALIASES.get(dtype, dtype)
+    return np.dtype(dtype)
+
+
+class _Chunk:
+    """Shared storage cell (analog of reference ``NDArray::Chunk``).
+
+    Holds the backing ``jax.Array`` in its *natural* (root) shape plus a
+    monotonically increasing version — the analog of the engine variable's
+    version chain in ``threaded_engine.h:71``.
+    """
+
+    __slots__ = ("data", "version")
+
+    def __init__(self, data: jax.Array):
+        self.data = data
+        self.version = 0
+
+    def write(self, new_data: jax.Array) -> None:
+        self.data = new_data
+        self.version += 1
+
+
+class NDArray:
+    """Mutable n-dimensional array on a device context."""
+
+    __slots__ = ("_chunk", "_ctx", "_shape", "_flat_begin", "_is_view", "writable")
+
+    # make numpy defer to our __r*__ operators
+    __array_priority__ = 100.0
+
+    def __init__(self, data: Union[jax.Array, np.ndarray], ctx: Optional[Context] = None,
+                 _chunk: Optional[_Chunk] = None, _flat_begin: int = 0,
+                 _shape: Optional[Tuple[int, ...]] = None, _is_view: bool = False,
+                 writable: bool = True):
+        if _chunk is not None:
+            self._chunk = _chunk
+            self._shape = tuple(_shape)
+            self._flat_begin = _flat_begin
+            self._is_view = _is_view
+            self._ctx = ctx if ctx is not None else default_ctx()
+        else:
+            ctx = ctx if ctx is not None else default_ctx()
+            arr = _to_device(data, ctx)
+            self._chunk = _Chunk(arr)
+            self._shape = tuple(arr.shape)
+            self._flat_begin = 0
+            self._is_view = False
+            self._ctx = ctx
+        self.writable = writable
+
+    # ------------------------------------------------------------------
+    # Properties
+    # ------------------------------------------------------------------
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return self._shape
+
+    @property
+    def size(self) -> int:
+        return int(np.prod(self._shape)) if self._shape else 1
+
+    @property
+    def ndim(self) -> int:
+        return len(self._shape)
+
+    @property
+    def dtype(self) -> np.dtype:
+        return np.dtype(self._chunk.data.dtype)
+
+    @property
+    def context(self) -> Context:
+        return self._ctx
+
+    ctx = context
+
+    @property
+    def data(self) -> jax.Array:
+        """The current value as an (immutable) jax.Array."""
+        root = self._chunk.data
+        if not self._is_view:
+            return root
+        flat = root.reshape(-1)
+        return jax.lax.dynamic_slice(flat, (self._flat_begin,), (self.size,)).reshape(self._shape)
+
+    @property
+    def version(self) -> int:
+        return self._chunk.version
+
+    # ------------------------------------------------------------------
+    # Mutation
+    # ------------------------------------------------------------------
+
+    def _write(self, value: jax.Array) -> None:
+        """Write `value` (shaped like this array/view) through to the chunk."""
+        if not self.writable:
+            raise MXNetError("trying to write to a read-only NDArray")
+        value = jnp.asarray(value, dtype=self.dtype)
+        value = jnp.broadcast_to(value, self._shape)
+        if not self._is_view:
+            self._chunk.write(value.reshape(self._chunk.data.shape))
+            return
+        root = self._chunk.data
+        flat = root.reshape(-1)
+        flat = jax.lax.dynamic_update_slice(flat, value.reshape(-1), (self._flat_begin,))
+        self._chunk.write(flat.reshape(root.shape))
+
+    def __setitem__(self, key, value) -> None:
+        if isinstance(value, NDArray):
+            value = value.data
+        if key is Ellipsis or (isinstance(key, slice) and key == slice(None)):
+            self._write(jnp.asarray(value))
+            return
+        cur = self.data
+        new = cur.at[key].set(jnp.asarray(value, dtype=self.dtype))
+        self._write(new)
+
+    def __getitem__(self, key) -> "NDArray":
+        if isinstance(key, int):
+            return self.at(key)
+        if isinstance(key, slice):
+            if key.step is not None and key.step != 1:
+                raise MXNetError("NDArray only supports step=1 slicing on axis 0")
+            start = key.start or 0
+            stop = self._shape[0] if key.stop is None else key.stop
+            return self.slice(start, stop)
+        raise MXNetError("NDArray indexing supports int and contiguous slice on axis 0")
+
+    # zero-copy views, analog of ndarray.h:227-261 ---------------------
+
+    def slice(self, start: int, stop: int) -> "NDArray":
+        if not self._shape:
+            raise MXNetError("cannot slice a scalar NDArray")
+        n = self._shape[0]
+        start = start + n if start < 0 else start
+        stop = stop + n if stop < 0 else stop
+        if not (0 <= start <= stop <= n):
+            raise MXNetError(f"slice [{start}:{stop}] out of range for axis of {n}")
+        inner = int(np.prod(self._shape[1:])) if len(self._shape) > 1 else 1
+        return NDArray(
+            None, ctx=self._ctx, _chunk=self._chunk,
+            _flat_begin=self._flat_begin + start * inner,
+            _shape=(stop - start,) + self._shape[1:], _is_view=True,
+            writable=self.writable)
+
+    def at(self, idx: int) -> "NDArray":
+        view = self.slice(idx, idx + 1)
+        view._shape = self._shape[1:] if len(self._shape) > 1 else (1,)
+        return view
+
+    def reshape(self, shape: Sequence[int]) -> "NDArray":
+        shape = tuple(int(s) for s in shape)
+        if -1 in shape:
+            rest = int(np.prod([s for s in shape if s != -1]))
+            shape = tuple(self.size // rest if s == -1 else s for s in shape)
+        if int(np.prod(shape)) != self.size:
+            raise MXNetError(f"cannot reshape {self._shape} -> {shape}")
+        return NDArray(
+            None, ctx=self._ctx, _chunk=self._chunk,
+            _flat_begin=self._flat_begin, _shape=shape,
+            _is_view=True if (self._is_view or shape != self._chunk.data.shape) else False,
+            writable=self.writable)
+
+    # ------------------------------------------------------------------
+    # Synchronization / transfer
+    # ------------------------------------------------------------------
+
+    def wait_to_read(self) -> None:
+        """Block until the value is computed (Engine::WaitForVar analog)."""
+        jax.block_until_ready(self._chunk.data)
+
+    def asnumpy(self) -> np.ndarray:
+        return np.asarray(self.data)
+
+    def asscalar(self):
+        if self.size != 1:
+            raise MXNetError("asscalar requires size-1 NDArray")
+        return self.asnumpy().reshape(()).item()
+
+    def copyto(self, other: Union["NDArray", Context]) -> "NDArray":
+        """Copy into another NDArray / a new array on a context.
+
+        Analog of ``CopyFromTo`` (``src/ndarray/ndarray.cc:226-287``); the
+        reference picks GPU streams + FnProperty per device pair — here the
+        transfer is a ``jax.device_put``.
+        """
+        if isinstance(other, Context):
+            out = NDArray(_to_device(self.data, other), ctx=other)
+            return out
+        if other is self:
+            return other
+        value = self.data
+        if other.context != self.context:
+            value = _to_device(value, other.context)
+        if tuple(value.shape) != other.shape:
+            raise MXNetError(f"copyto shape mismatch {value.shape} vs {other.shape}")
+        other._write(value.astype(other.dtype))
+        return other
+
+    def as_in_context(self, ctx: Context) -> "NDArray":
+        if ctx == self._ctx:
+            return self
+        return self.copyto(ctx)
+
+    def astype(self, dtype) -> "NDArray":
+        return NDArray(self.data.astype(_as_dtype(dtype)), ctx=self._ctx)
+
+    def copy(self) -> "NDArray":
+        return NDArray(self.data + 0, ctx=self._ctx)
+
+    # ------------------------------------------------------------------
+    # Arithmetic — each returns a fresh NDArray (engine-push analog)
+    # ------------------------------------------------------------------
+
+    def _binop(self, other, opname, rev_scalar_opname=None, reverse=False):
+        if isinstance(other, NDArray):
+            lhs, rhs = (other, self) if reverse else (self, other)
+            return imperative_invoke(opname, [lhs, rhs], {})
+        if isinstance(other, (int, float, np.integer, np.floating)):
+            name = rev_scalar_opname if (reverse and rev_scalar_opname) else opname + "_scalar"
+            return imperative_invoke(name, [self], {"scalar": float(other)})
+        return NotImplemented
+
+    def __add__(self, o): return self._binop(o, "_plus")
+    def __radd__(self, o): return self._binop(o, "_plus")
+    def __sub__(self, o): return self._binop(o, "_minus", "_rminus_scalar")
+    def __rsub__(self, o): return self._binop(o, "_minus", "_rminus_scalar", reverse=True)
+    def __mul__(self, o): return self._binop(o, "_mul")
+    def __rmul__(self, o): return self._binop(o, "_mul")
+    def __truediv__(self, o): return self._binop(o, "_div", "_rdiv_scalar")
+    def __rtruediv__(self, o): return self._binop(o, "_div", "_rdiv_scalar", reverse=True)
+    def __pow__(self, o): return self._binop(o, "_power", "_rpower_scalar")
+    def __rpow__(self, o): return self._binop(o, "_power", "_rpower_scalar", reverse=True)
+    def __neg__(self): return imperative_invoke("_mul_scalar", [self], {"scalar": -1.0})
+
+    def _ibinop(self, other, opname):
+        out = self._binop(other, opname)
+        self._write(out.data)
+        return self
+
+    def __iadd__(self, o): return self._ibinop(o, "_plus")
+    def __isub__(self, o): return self._ibinop(o, "_minus")
+    def __imul__(self, o): return self._ibinop(o, "_mul")
+    def __itruediv__(self, o): return self._ibinop(o, "_div")
+
+    def __eq__(self, other):
+        if isinstance(other, NDArray):
+            return bool(self is other)
+        return NotImplemented
+
+    def __hash__(self):
+        return id(self)
+
+    def __len__(self) -> int:
+        if not self._shape:
+            raise MXNetError("len() of a scalar NDArray")
+        return self._shape[0]
+
+    def __repr__(self):
+        return f"<NDArray {self._shape} @{self._ctx} {self.dtype.name}>"
+
+    # numpy interop
+    def __array__(self, dtype=None):
+        out = self.asnumpy()
+        return out.astype(dtype) if dtype is not None else out
+
+    # persistence helpers used by save/load
+    def _serialize(self) -> Tuple[np.ndarray]:
+        return self.asnumpy()
+
+
+# ---------------------------------------------------------------------------
+# Device placement
+# ---------------------------------------------------------------------------
+
+
+def _to_device(data, ctx: Context) -> jax.Array:
+    dev = ctx.jax_device
+    if isinstance(data, jax.Array) and len(data.devices()) == 1 and next(iter(data.devices())) == dev:
+        return data
+    return jax.device_put(jnp.asarray(data), dev)
+
+
+# ---------------------------------------------------------------------------
+# Constructors (reference python/mxnet/ndarray.py zeros/ones/array/empty)
+# ---------------------------------------------------------------------------
+
+
+def empty(shape, ctx: Optional[Context] = None, dtype=None) -> NDArray:
+    return zeros(shape, ctx=ctx, dtype=dtype)
+
+
+def zeros(shape, ctx: Optional[Context] = None, dtype=None) -> NDArray:
+    if isinstance(shape, int):
+        shape = (shape,)
+    return NDArray(jnp.zeros(tuple(shape), dtype=_as_dtype(dtype)), ctx=ctx)
+
+
+def ones(shape, ctx: Optional[Context] = None, dtype=None) -> NDArray:
+    if isinstance(shape, int):
+        shape = (shape,)
+    return NDArray(jnp.ones(tuple(shape), dtype=_as_dtype(dtype)), ctx=ctx)
+
+
+def full(shape, val, ctx: Optional[Context] = None, dtype=None) -> NDArray:
+    if isinstance(shape, int):
+        shape = (shape,)
+    return NDArray(jnp.full(tuple(shape), val, dtype=_as_dtype(dtype)), ctx=ctx)
+
+
+def array(source_array, ctx: Optional[Context] = None, dtype=None) -> NDArray:
+    if isinstance(source_array, NDArray):
+        src = source_array.data
+        if dtype is not None:
+            src = src.astype(_as_dtype(dtype))
+        return NDArray(src, ctx=ctx if ctx is not None else source_array.context)
+    arr = np.asarray(source_array, dtype=_as_dtype(dtype) if dtype is not None
+                     else (np.float32 if np.asarray(source_array).dtype == np.float64
+                           else None))
+    return NDArray(arr, ctx=ctx)
+
+
+def arange(start, stop=None, step=1.0, ctx=None, dtype=None) -> NDArray:
+    if stop is None:
+        start, stop = 0, start
+    return NDArray(jnp.arange(start, stop, step, dtype=_as_dtype(dtype)), ctx=ctx)
+
+
+def concatenate(arrays: Sequence[NDArray], axis: int = 0) -> NDArray:
+    return NDArray(jnp.concatenate([a.data for a in arrays], axis=axis),
+                   ctx=arrays[0].context)
+
+
+def waitall() -> None:
+    """Engine::WaitForAll analog — effectively a no-op barrier helper."""
+    (jax.device_put(0.0) + 0).block_until_ready()
+
+
+# ---------------------------------------------------------------------------
+# Save / load — binary format analog of NDArray::Save/Load (ndarray.h:275-286)
+# ---------------------------------------------------------------------------
+
+_SAVE_MAGIC = b"MXTPUND1"
+
+
+def save(fname: str, data: Union[List[NDArray], Dict[str, NDArray]]) -> None:
+    """Save a list or dict of NDArrays (reference ``ndarray.py:save``)."""
+    if isinstance(data, dict):
+        names = list(data.keys())
+        arrays = [data[k] for k in names]
+    elif isinstance(data, (list, tuple)):
+        names = []
+        arrays = list(data)
+    else:
+        raise MXNetError("save expects list or dict of NDArrays")
+    with open(fname, "wb") as f:
+        f.write(_SAVE_MAGIC)
+        f.write(struct.pack("<qq", len(arrays), len(names)))
+        for i, arr in enumerate(arrays):
+            np_arr = arr.asnumpy() if isinstance(arr, NDArray) else np.asarray(arr)
+            dt = np_arr.dtype.str.encode()
+            f.write(struct.pack("<i", len(dt)))
+            f.write(dt)
+            f.write(struct.pack("<i", np_arr.ndim))
+            f.write(struct.pack(f"<{np_arr.ndim}q", *np_arr.shape))
+            f.write(np_arr.tobytes())
+        for name in names:
+            nb = name.encode()
+            f.write(struct.pack("<i", len(nb)))
+            f.write(nb)
+
+
+def load(fname: str) -> Union[List[NDArray], Dict[str, NDArray]]:
+    with open(fname, "rb") as f:
+        magic = f.read(8)
+        if magic != _SAVE_MAGIC:
+            raise MXNetError(f"{fname}: bad magic, not an NDArray file")
+        n_arr, n_names = struct.unpack("<qq", f.read(16))
+        arrays = []
+        for _ in range(n_arr):
+            (dt_len,) = struct.unpack("<i", f.read(4))
+            dt = np.dtype(f.read(dt_len).decode())
+            (ndim,) = struct.unpack("<i", f.read(4))
+            shape = struct.unpack(f"<{ndim}q", f.read(8 * ndim)) if ndim else ()
+            count = int(np.prod(shape)) if shape else 1
+            buf = f.read(count * dt.itemsize)
+            arrays.append(NDArray(np.frombuffer(buf, dtype=dt).reshape(shape).copy()))
+        names = []
+        for _ in range(n_names):
+            (ln,) = struct.unpack("<i", f.read(4))
+            names.append(f.read(ln).decode())
+    if names:
+        return dict(zip(names, arrays))
+    return arrays
+
+
+# ---------------------------------------------------------------------------
+# Imperative invocation of registered ops
+# ---------------------------------------------------------------------------
+
+
+def imperative_invoke(opname: str, inputs: Sequence[NDArray], raw_params: Dict[str, Any],
+                      out: Optional[Union[NDArray, List[NDArray]]] = None,
+                      ctx: Optional[Context] = None) -> Union[NDArray, List[NDArray]]:
+    """Run a registered op eagerly on NDArrays.
+
+    The analog of ``MXFuncInvoke`` → registered function body →
+    ``Engine::PushSync`` (``ndarray.cc:203-219``): JAX's async dispatch
+    replaces the engine push, so this returns before compute completes.
+    """
+    op = get_op(opname)
+    params = op.parse_params(raw_params)
+    if ctx is None:
+        ctx = inputs[0].context if inputs else current_context()
+    rng = None
+    if op.needs_rng:
+        from . import random as _random
+        rng = _random._next_key()
+    opctx = OpContext(is_train=False, rng=rng)
+    result = op.forward(opctx, params, *[x.data for x in inputs])
+    results = list(result) if isinstance(result, (tuple, list)) else [result]
+    outs = [NDArray(r, ctx=ctx) for r in results]
+    if out is not None:
+        out_list = out if isinstance(out, (list, tuple)) else [out]
+        for dst, src in zip(out_list, outs):
+            dst._write(src.data)
+        outs = list(out_list)
+    return outs[0] if len(outs) == 1 else outs
+
+
+def _make_ndarray_function(opname: str, func_name: str):
+    op = get_op(opname)
+    param_names = list(op.params)
+    n_args = len(op.arguments) if not callable(op.arguments) else None
+
+    def fn(*args, **kwargs):
+        out = kwargs.pop("out", None)
+        arrs = []
+        scalars: Dict[str, Any] = {}
+        remaining = list(param_names)
+        for a in args:
+            if isinstance(a, NDArray):
+                arrs.append(a)
+            else:
+                # positional scalar params in declaration order (matches the
+                # reference's generated-function calling convention)
+                while remaining and remaining[0] in kwargs:
+                    remaining.pop(0)
+                if not remaining:
+                    raise MXNetError(f"{func_name}: too many positional args")
+                scalars[remaining.pop(0)] = a
+        scalars.update(kwargs)
+        return imperative_invoke(opname, arrs, scalars, out=out)
+
+    fn.__name__ = func_name
+    fn.__doc__ = op.doc or f"{opname} (auto-generated from op registry)"
+    return fn
+
+
+def _init_ndarray_module() -> None:
+    """Populate this module with functions from the op registry."""
+    g = globals()
+    for name, op in OP_REGISTRY.items():
+        if op.func_name is None:
+            continue
+        fname = op.func_name
+        public = not fname.startswith("_")
+        if fname in g and not public:
+            continue
+        if fname in ("array", "save", "load", "zeros", "ones", "full", "empty"):
+            continue
+        g[fname] = _make_ndarray_function(name, fname)
+        if public and fname not in __all__:
+            __all__.append(fname)
+
+
+_init_ndarray_module()

@@ -1,0 +1,12 @@
+"""Operator registry and the operator zoo.
+
+Importing this package registers all built-in ops (the analog of the static
+registration the reference does via ``MXNET_REGISTER_OP_PROPERTY`` /
+``MXNET_REGISTER_SIMPLE_OP`` at library load).
+"""
+from .registry import (OP_REGISTRY, OpContext, OpDef, OpParam, get_op,
+                       list_ops, register_op)
+from . import simple_ops  # noqa: F401  (registers simple ops)
+
+__all__ = ["OP_REGISTRY", "OpContext", "OpDef", "OpParam", "get_op",
+           "list_ops", "register_op"]

@@ -1,0 +1,239 @@
+"""Operator registry: declarative metadata + JAX-backed implementations.
+
+This is the TPU-native replacement for three reference mechanisms at once:
+
+* ``OperatorProperty`` (``include/mxnet/operator.h:165-530``) — op metadata:
+  ``ListArguments/ListOutputs/ListAuxiliaryStates``, ``InferShape``,
+  ``InferType``.
+* ``MXNET_REGISTER_OP_PROPERTY`` / ``MXNET_REGISTER_SIMPLE_OP``
+  (``operator.h:537``, ``operator_util.h:479``) — one registration exposes
+  an op to *both* the imperative NDArray API and the symbolic Symbol API.
+* ``dmlc::Parameter`` — declarative per-op parameters with types, defaults,
+  bounds and docs (e.g. ``FullyConnectedParam``,
+  ``src/operator/fully_connected-inl.h:29-39``).
+
+Backward is not registered per-op: ops are pure JAX functions, so autodiff
+is structural.  Ops needing reference-specific gradient semantics (e.g.
+``SoftmaxOutput`` ignoring head gradients) use ``jax.custom_vjp`` inside
+their forward implementation.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from ..base import MXNetError, Registry
+
+__all__ = [
+    "OpParam", "OpDef", "OpContext", "register_op", "get_op", "list_ops",
+    "OP_REGISTRY", "elemwise_shape", "same_shape",
+]
+
+
+# ---------------------------------------------------------------------------
+# Declarative parameters (dmlc::Parameter analog)
+# ---------------------------------------------------------------------------
+
+def _parse_bool(v) -> bool:
+    if isinstance(v, bool):
+        return v
+    if isinstance(v, (int, np.integer)):
+        return bool(v)
+    s = str(v).strip().lower()
+    if s in ("true", "1"):
+        return True
+    if s in ("false", "0"):
+        return False
+    raise ValueError(f"cannot parse bool from {v!r}")
+
+
+def _parse_shape(v) -> Tuple[int, ...]:
+    if isinstance(v, (tuple, list)):
+        return tuple(int(x) for x in v)
+    s = str(v).strip()
+    val = ast.literal_eval(s)
+    if isinstance(val, (int, float)):
+        return (int(val),)
+    return tuple(int(x) for x in val)
+
+
+_PARAM_PARSERS: Dict[str, Callable[[Any], Any]] = {
+    "int": lambda v: int(float(v)) if not isinstance(v, str) or v.strip().lstrip("+-").isdigit() or "." in v else int(v),
+    "float": float,
+    "bool": _parse_bool,
+    "str": str,
+    "shape": _parse_shape,
+}
+
+
+@dataclass
+class OpParam:
+    """One declarative op parameter (a dmlc::Parameter field)."""
+
+    name: str
+    type: str = "str"                   # int | float | bool | str | shape
+    default: Any = None
+    required: bool = False
+    enum: Optional[Sequence[str]] = None
+    doc: str = ""
+
+    def parse(self, value: Any) -> Any:
+        if value is None:
+            if self.required:
+                raise MXNetError(f"required parameter '{self.name}' missing")
+            return self.default
+        try:
+            out = _PARAM_PARSERS[self.type](value)
+        except (ValueError, SyntaxError) as e:
+            raise MXNetError(f"parameter '{self.name}': {e}") from e
+        if self.enum is not None and out not in self.enum:
+            raise MXNetError(
+                f"parameter '{self.name}' must be one of {list(self.enum)}, got {out!r}")
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Op execution context
+# ---------------------------------------------------------------------------
+
+class OpContext:
+    """Per-invocation state handed to op forward functions.
+
+    Carries what the reference passes via ``OpContext`` + ``Resource``
+    (``include/mxnet/operator.h:56-74``, ``resource.h``): training flag and
+    the PRNG stream (``ResourceRequest::kRandom``).  Aux-state I/O replaces
+    the reference's mutable auxiliary ``TBlob`` list.
+    """
+
+    __slots__ = ("is_train", "rng", "aux", "aux_updates", "name")
+
+    def __init__(self, is_train: bool = False, rng=None,
+                 aux: Optional[Dict[str, Any]] = None, name: str = ""):
+        self.is_train = is_train
+        self.rng = rng                    # jax PRNG key or None
+        self.aux = aux or {}              # read: current aux state values
+        self.aux_updates: Dict[str, Any] = {}  # write: new aux state values
+        self.name = name
+
+
+# ---------------------------------------------------------------------------
+# Op definition
+# ---------------------------------------------------------------------------
+
+ShapeT = Optional[Tuple[int, ...]]
+ListOrFn = Union[Sequence[str], Callable[[Dict[str, Any]], Sequence[str]]]
+
+
+def _resolve(lst: ListOrFn, params: Dict[str, Any]) -> List[str]:
+    if callable(lst):
+        return list(lst(params))
+    return list(lst)
+
+
+@dataclass
+class OpDef:
+    """A registered operator.
+
+    ``forward(ctx, params, *inputs) -> jnp array or tuple of arrays``.
+    ``infer_shape(params, in_shapes) -> (in_shapes, out_shapes, aux_shapes)``
+    where unknown input shapes arrive as ``None`` and must be filled in (or
+    left ``None`` if truly uninferable — analog of partial infer).
+    """
+
+    name: str
+    forward: Callable[..., Any]
+    arguments: ListOrFn = ("data",)
+    outputs: ListOrFn = ("output",)
+    aux_states: ListOrFn = ()
+    params: Dict[str, OpParam] = field(default_factory=dict)
+    infer_shape: Optional[Callable[..., Tuple[List[ShapeT], List[ShapeT], List[ShapeT]]]] = None
+    infer_type: Optional[Callable[..., Any]] = None
+    doc: str = ""
+    # ops whose python-level function name differs (e.g. '_plus')
+    func_name: Optional[str] = None
+    # True for loss-style heads whose backward ignores out_grad
+    is_loss: bool = False
+    # True if op needs PRNG (dropout, sampling)
+    needs_rng: bool = False
+
+    def list_arguments(self, params: Dict[str, Any]) -> List[str]:
+        return _resolve(self.arguments, params)
+
+    def list_outputs(self, params: Dict[str, Any]) -> List[str]:
+        return _resolve(self.outputs, params)
+
+    def list_aux_states(self, params: Dict[str, Any]) -> List[str]:
+        return _resolve(self.aux_states, params)
+
+    def parse_params(self, raw: Dict[str, Any]) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        for pname, spec in self.params.items():
+            out[pname] = spec.parse(raw.get(pname))
+        unknown = set(raw) - set(self.params)
+        if unknown:
+            # tolerate unknown attrs the way the reference tolerates __xxx__
+            bad = [u for u in unknown if not (u.startswith("__") and u.endswith("__"))]
+            if bad:
+                raise MXNetError(f"op {self.name}: unknown parameter(s) {sorted(bad)}")
+        return out
+
+    def do_infer_shape(self, params: Dict[str, Any], in_shapes: List[ShapeT]):
+        if self.infer_shape is None:
+            return elemwise_shape(params, in_shapes)
+        return self.infer_shape(params, in_shapes)
+
+    def do_infer_type(self, params: Dict[str, Any], in_types: List[Optional[np.dtype]]):
+        if self.infer_type is not None:
+            return self.infer_type(params, in_types)
+        # default: all inputs/outputs/aux share one dtype
+        known = [t for t in in_types if t is not None]
+        dt = known[0] if known else None
+        n_in = len(self.list_arguments(params))
+        n_out = len(self.list_outputs(params))
+        n_aux = len(self.list_aux_states(params))
+        return ([dt] * n_in, [dt] * n_out, [dt] * n_aux)
+
+
+# ---------------------------------------------------------------------------
+# Common shape functions
+# ---------------------------------------------------------------------------
+
+def elemwise_shape(params, in_shapes):
+    """All inputs and the single output share one shape (SameShape in ref)."""
+    known = [s for s in in_shapes if s is not None]
+    if not known:
+        return in_shapes, [None], []
+    shp = known[0]
+    for s in known[1:]:
+        if tuple(s) != tuple(shp):
+            raise MXNetError(f"incompatible shapes {s} vs {shp}")
+    return [tuple(shp)] * len(in_shapes), [tuple(shp)], []
+
+
+same_shape = elemwise_shape
+
+
+# ---------------------------------------------------------------------------
+# Registry
+# ---------------------------------------------------------------------------
+
+OP_REGISTRY: Registry[OpDef] = Registry("operator")
+
+
+def register_op(opdef: OpDef) -> OpDef:
+    OP_REGISTRY.register(opdef, name=opdef.name)
+    return opdef
+
+
+def get_op(name: str) -> OpDef:
+    try:
+        return OP_REGISTRY.get(name)
+    except KeyError as e:
+        raise MXNetError(str(e)) from e
+
+
+def list_ops() -> List[str]:
+    return OP_REGISTRY.list()

@@ -1,0 +1,509 @@
+"""Simple (tensor-algebra) operators.
+
+TPU-native rebuild of the reference's "simple op" registry
+(``include/mxnet/operator_util.h:100-479`` + ``src/operator/
+{elementwise_unary_op,elementwise_binary_op,broadcast_reduce_op,matrix_op,
+sample_op,loss_binary_op,smooth_l1_unary}-inl.h``): one registration exposes
+each op to both the imperative NDArray API and the symbolic Symbol API.
+
+Implementations are ``jax.numpy`` one-liners — mshadow's expression templates
+are exactly XLA's fusion domain, so there is nothing to hand-schedule here;
+gradient functions (``SetGradFnXxx`` in the reference) are structural autodiff.
+"""
+from __future__ import annotations
+
+from typing import List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import MXNetError
+from .registry import OpDef, OpParam, elemwise_shape, register_op
+
+__all__ = []  # ops land in the registry, not this namespace
+
+
+# ---------------------------------------------------------------------------
+# Shape helpers
+# ---------------------------------------------------------------------------
+
+def _scalar_shape(params, in_shapes):
+    return elemwise_shape(params, in_shapes)
+
+
+def _reduce_all_shape(params, in_shapes):
+    return in_shapes, [(1,)], []
+
+
+def _broadcast_binary_shape(params, in_shapes):
+    a, b = in_shapes
+    if a is None or b is None:
+        return in_shapes, [None], []
+    out = tuple(np.broadcast_shapes(tuple(a), tuple(b)))
+    return [tuple(a), tuple(b)], [out], []
+
+
+def _dot_shape(params, in_shapes):
+    a, b = in_shapes
+    if a is None or b is None:
+        return in_shapes, [None], []
+    a, b = tuple(a), tuple(b)
+    if len(a) == 1 and len(b) == 1:
+        if a[0] != b[0]:
+            raise MXNetError(f"dot shape mismatch {a} {b}")
+        return [a, b], [(1,)], []
+    if len(a) == 2 and len(b) == 2:
+        if a[1] != b[0]:
+            raise MXNetError(f"dot shape mismatch {a} {b}")
+        return [a, b], [(a[0], b[1])], []
+    raise MXNetError(f"dot supports 1D/2D, got {a} x {b}")
+
+
+# ---------------------------------------------------------------------------
+# Registration helpers (analog of MXNET_REGISTER_SIMPLE_OP chains)
+# ---------------------------------------------------------------------------
+
+def _unary(name, fn, func_name=None, doc=""):
+    register_op(OpDef(
+        name=name,
+        forward=lambda ctx, params, x, _fn=fn: _fn(x),
+        arguments=("data",),
+        infer_shape=elemwise_shape,
+        func_name=func_name or name,
+        doc=doc,
+    ))
+
+
+def _binary(name, fn, func_name=None, doc="", shape_fn=elemwise_shape):
+    register_op(OpDef(
+        name=name,
+        forward=lambda ctx, params, lhs, rhs, _fn=fn: _fn(lhs, rhs),
+        arguments=("lhs", "rhs"),
+        infer_shape=shape_fn,
+        func_name=func_name or name,
+        doc=doc,
+    ))
+
+
+def _binary_scalar(name, fn, doc=""):
+    """Array-op-scalar (and reverse) variants, e.g. ``_plus_scalar``."""
+    register_op(OpDef(
+        name=name,
+        forward=lambda ctx, params, x, _fn=fn: _fn(x, params["scalar"]),
+        arguments=("data",),
+        params={"scalar": OpParam("scalar", "float", required=True)},
+        infer_shape=elemwise_shape,
+        func_name=name,
+        doc=doc,
+    ))
+
+
+# ---------------------------------------------------------------------------
+# Elementwise binary (elementwise_binary_op-inl.h)
+# ---------------------------------------------------------------------------
+
+_binary("_plus", jnp.add, doc="elementwise add")
+_binary("_minus", jnp.subtract, doc="elementwise subtract")
+_binary("_mul", jnp.multiply, doc="elementwise multiply")
+_binary("_div", jnp.divide, doc="elementwise divide")
+_binary("_power", jnp.power, doc="elementwise power")
+_binary("_maximum", jnp.maximum, doc="elementwise maximum")
+_binary("_minimum", jnp.minimum, doc="elementwise minimum")
+
+_binary_scalar("_plus_scalar", lambda x, s: x + s)
+_binary_scalar("_minus_scalar", lambda x, s: x - s)
+_binary_scalar("_rminus_scalar", lambda x, s: s - x)
+_binary_scalar("_mul_scalar", lambda x, s: x * s)
+_binary_scalar("_div_scalar", lambda x, s: x / s)
+_binary_scalar("_rdiv_scalar", lambda x, s: s / x)
+_binary_scalar("_power_scalar", lambda x, s: jnp.power(x, s))
+_binary_scalar("_rpower_scalar", lambda x, s: jnp.power(s, x))
+_binary_scalar("_maximum_scalar", lambda x, s: jnp.maximum(x, s))
+_binary_scalar("_minimum_scalar", lambda x, s: jnp.minimum(x, s))
+
+# ---------------------------------------------------------------------------
+# Elementwise unary math (elementwise_unary_op-inl.h)
+# ---------------------------------------------------------------------------
+
+_unary("abs", jnp.abs)
+_unary("sign", jnp.sign)
+_unary("round", jnp.round)
+_unary("ceil", jnp.ceil)
+_unary("floor", jnp.floor)
+_unary("square", jnp.square)
+_unary("sqrt", jnp.sqrt)
+_unary("rsqrt", jax.lax.rsqrt)
+_unary("exp", jnp.exp)
+_unary("log", jnp.log)
+_unary("cos", jnp.cos)
+_unary("sin", jnp.sin)
+_unary("negative", jnp.negative, func_name="negative")
+_unary("sigmoid", jax.nn.sigmoid)
+_unary("relu", jax.nn.relu)
+_unary("tanh", jnp.tanh)
+
+register_op(OpDef(
+    name="clip",
+    forward=lambda ctx, params, x: jnp.clip(x, params["a_min"], params["a_max"]),
+    arguments=("data",),
+    params={
+        "a_min": OpParam("a_min", "float", required=True),
+        "a_max": OpParam("a_max", "float", required=True),
+    },
+    infer_shape=elemwise_shape,
+    func_name="clip",
+    doc="clip values to [a_min, a_max]",
+))
+
+# ---------------------------------------------------------------------------
+# Reductions (broadcast_reduce_op-inl.h)
+# ---------------------------------------------------------------------------
+
+_unary("norm", lambda x: jnp.sqrt(jnp.sum(jnp.square(x))).reshape(1), func_name="norm")
+# whole-array reductions return shape-(1,) arrays, matching the reference
+for _rname, _rfn in (("sum", jnp.sum), ("max", jnp.max), ("min", jnp.min)):
+    register_op(OpDef(
+        name=_rname,
+        forward=lambda ctx, params, x, _fn=_rfn: _fn(x).reshape(1),
+        arguments=("data",),
+        infer_shape=_reduce_all_shape,
+        func_name=_rname,
+        doc=f"{_rname} over all elements",
+    ))
+
+
+def _axis_reduce_shape(params, in_shapes):
+    (s,) = in_shapes
+    if s is None:
+        return in_shapes, [None], []
+    axes = params["axis"]
+    if isinstance(axes, int):
+        axes = (axes,)
+    axes = tuple(a % len(s) for a in axes)
+    if params.get("keepdims"):
+        out = tuple(1 if i in axes else d for i, d in enumerate(s))
+    else:
+        out = tuple(d for i, d in enumerate(s) if i not in axes)
+        if out == ():
+            out = (1,)
+    return [tuple(s)], [out], []
+
+
+def _make_axis_reduce(name, fn):
+    def fwd(ctx, params, x, _fn=fn):
+        axes = params["axis"]
+        if isinstance(axes, tuple) and len(axes) == 1:
+            axes = axes[0]
+        out = _fn(x, axis=axes, keepdims=bool(params["keepdims"]))
+        if out.ndim == 0:
+            out = out.reshape(1)
+        return out
+    register_op(OpDef(
+        name=name,
+        forward=fwd,
+        arguments=("data",),
+        params={
+            "axis": OpParam("axis", "shape", default=(0,)),
+            "keepdims": OpParam("keepdims", "bool", default=False),
+        },
+        infer_shape=_axis_reduce_shape,
+        func_name=name,
+        doc=f"{name} over given axes",
+    ))
+
+
+_make_axis_reduce("sum_axis", jnp.sum)
+_make_axis_reduce("max_axis", jnp.max)
+_make_axis_reduce("min_axis", jnp.min)
+
+register_op(OpDef(
+    name="argmax_channel",
+    forward=lambda ctx, params, x: jnp.argmax(x, axis=1).astype(x.dtype),
+    arguments=("data",),
+    infer_shape=lambda params, in_shapes: (
+        in_shapes,
+        [None if in_shapes[0] is None else (in_shapes[0][0],)],
+        []),
+    func_name="argmax_channel",
+    doc="argmax over axis 1 (channel), reference broadcast_reduce_op-inl.h",
+))
+
+# ---------------------------------------------------------------------------
+# Broadcasting ops (broadcast_reduce_op-inl.h)
+# ---------------------------------------------------------------------------
+
+
+def _broadcast_axis_shape(params, in_shapes):
+    (s,) = in_shapes
+    if s is None:
+        return in_shapes, [None], []
+    axes = params["axis"]
+    sizes = params["size"]
+    if isinstance(axes, int):
+        axes = (axes,)
+    if isinstance(sizes, int):
+        sizes = (sizes,)
+    out = list(s)
+    for a, sz in zip(axes, sizes):
+        if s[a] != 1:
+            raise MXNetError(f"broadcast_axis: axis {a} of {s} must be 1")
+        out[a] = sz
+    return [tuple(s)], [tuple(out)], []
+
+
+def _broadcast_axis_fwd(ctx, params, x):
+    axes = params["axis"]
+    sizes = params["size"]
+    if isinstance(axes, int):
+        axes = (axes,)
+    if isinstance(sizes, int):
+        sizes = (sizes,)
+    target = list(x.shape)
+    for a, sz in zip(axes, sizes):
+        target[a] = sz
+    return jnp.broadcast_to(x, tuple(target))
+
+
+register_op(OpDef(
+    name="broadcast_axis",
+    forward=_broadcast_axis_fwd,
+    arguments=("data",),
+    params={
+        "axis": OpParam("axis", "shape", default=(0,)),
+        "size": OpParam("size", "shape", default=(1,)),
+    },
+    infer_shape=_broadcast_axis_shape,
+    func_name="broadcast_axis",
+))
+
+_binary("broadcast_plus", jnp.add, shape_fn=_broadcast_binary_shape)
+_binary("broadcast_minus", jnp.subtract, shape_fn=_broadcast_binary_shape)
+_binary("broadcast_mul", jnp.multiply, shape_fn=_broadcast_binary_shape)
+_binary("broadcast_div", jnp.divide, shape_fn=_broadcast_binary_shape)
+_binary("broadcast_power", jnp.power, shape_fn=_broadcast_binary_shape)
+
+# ---------------------------------------------------------------------------
+# Matrix ops (matrix_op-inl.h)
+# ---------------------------------------------------------------------------
+
+_binary("dot", lambda a, b: jnp.dot(a, b).reshape(1) if a.ndim == 1 and b.ndim == 1
+        else jnp.dot(a, b), shape_fn=_dot_shape, doc="matrix/vector product (MXU)")
+
+
+def _transpose_shape(params, in_shapes):
+    (s,) = in_shapes
+    if s is None:
+        return in_shapes, [None], []
+    axes = params["axes"]
+    if not axes:
+        axes = tuple(reversed(range(len(s))))
+    out = tuple(s[a] for a in axes)
+    return [tuple(s)], [out], []
+
+
+register_op(OpDef(
+    name="transpose",
+    forward=lambda ctx, params, x: jnp.transpose(
+        x, params["axes"] if params["axes"] else None),
+    arguments=("data",),
+    params={"axes": OpParam("axes", "shape", default=())},
+    infer_shape=_transpose_shape,
+    func_name="transpose",
+))
+
+
+def _expand_dims_shape(params, in_shapes):
+    (s,) = in_shapes
+    if s is None:
+        return in_shapes, [None], []
+    ax = params["axis"]
+    out = list(s)
+    out.insert(ax if ax >= 0 else len(s) + 1 + ax, 1)
+    return [tuple(s)], [tuple(out)], []
+
+
+register_op(OpDef(
+    name="expand_dims",
+    forward=lambda ctx, params, x: jnp.expand_dims(x, params["axis"]),
+    arguments=("data",),
+    params={"axis": OpParam("axis", "int", required=True)},
+    infer_shape=_expand_dims_shape,
+    func_name="expand_dims",
+))
+
+
+def _slice_axis_shape(params, in_shapes):
+    (s,) = in_shapes
+    if s is None:
+        return in_shapes, [None], []
+    ax = params["axis"] % len(s)
+    begin, end = params["begin"], params["end"]
+    if end is None or end == 0:
+        end = s[ax]
+    if end < 0:
+        end += s[ax]
+    if begin < 0:
+        begin += s[ax]
+    out = list(s)
+    out[ax] = end - begin
+    return [tuple(s)], [tuple(out)], []
+
+
+def _slice_axis_fwd(ctx, params, x):
+    ax = params["axis"] % x.ndim
+    begin, end = params["begin"], params["end"]
+    if end is None or end == 0:
+        end = x.shape[ax]
+    return jax.lax.slice_in_dim(x, begin, end, axis=ax)
+
+
+register_op(OpDef(
+    name="slice_axis",
+    forward=_slice_axis_fwd,
+    arguments=("data",),
+    params={
+        "axis": OpParam("axis", "int", required=True),
+        "begin": OpParam("begin", "int", required=True),
+        "end": OpParam("end", "int", default=0),
+    },
+    infer_shape=_slice_axis_shape,
+    func_name="slice_axis",
+))
+
+register_op(OpDef(
+    name="flip",
+    forward=lambda ctx, params, x: jnp.flip(x, params["axis"]),
+    arguments=("data",),
+    params={"axis": OpParam("axis", "int", required=True)},
+    infer_shape=elemwise_shape,
+    func_name="flip",
+))
+
+# ---------------------------------------------------------------------------
+# Losses (smooth_l1_unary-inl.h, loss_binary_op-inl.h)
+# ---------------------------------------------------------------------------
+
+
+def _smooth_l1(ctx, params, x):
+    sigma = params["sigma"]
+    s2 = sigma * sigma
+    absx = jnp.abs(x)
+    return jnp.where(absx < 1.0 / s2, 0.5 * s2 * x * x, absx - 0.5 / s2)
+
+
+register_op(OpDef(
+    name="smooth_l1",
+    forward=_smooth_l1,
+    arguments=("data",),
+    params={"sigma": OpParam("sigma", "float", default=1.0)},
+    infer_shape=elemwise_shape,
+    func_name="smooth_l1",
+))
+
+
+def _softmax_ce_shape(params, in_shapes):
+    return in_shapes, [(1,)], []
+
+
+def _softmax_cross_entropy(ctx, params, data, label):
+    logp = jax.nn.log_softmax(data, axis=-1)
+    idx = label.astype(jnp.int32)
+    picked = jnp.take_along_axis(logp, idx[:, None], axis=-1)[:, 0]
+    return -jnp.sum(picked).reshape(1)
+
+
+register_op(OpDef(
+    name="softmax_cross_entropy",
+    forward=_softmax_cross_entropy,
+    arguments=("data", "label"),
+    infer_shape=_softmax_ce_shape,
+    func_name="softmax_cross_entropy",
+))
+
+# ---------------------------------------------------------------------------
+# Sampling (sample_op-inl.h) — PRNG comes from the op context (Resource kRandom)
+# ---------------------------------------------------------------------------
+
+
+def _sample_shape(params, in_shapes):
+    return [], [tuple(params["shape"])], []
+
+
+register_op(OpDef(
+    name="_sample_uniform",
+    forward=lambda ctx, params: jax.random.uniform(
+        ctx.rng, tuple(params["shape"]),
+        minval=params["low"], maxval=params["high"]),
+    arguments=(),
+    params={
+        "low": OpParam("low", "float", default=0.0),
+        "high": OpParam("high", "float", default=1.0),
+        "shape": OpParam("shape", "shape", required=True),
+    },
+    infer_shape=_sample_shape,
+    func_name="_sample_uniform",
+    needs_rng=True,
+))
+
+register_op(OpDef(
+    name="_sample_normal",
+    forward=lambda ctx, params: params["loc"] + params["scale"] * jax.random.normal(
+        ctx.rng, tuple(params["shape"])),
+    arguments=(),
+    params={
+        "loc": OpParam("loc", "float", default=0.0),
+        "scale": OpParam("scale", "float", default=1.0),
+        "shape": OpParam("shape", "shape", required=True),
+    },
+    infer_shape=_sample_shape,
+    func_name="_sample_normal",
+    needs_rng=True,
+))
+
+# ---------------------------------------------------------------------------
+# NDArray-only helpers from src/ndarray/ndarray.cc (registered as simple ops
+# so both APIs see them, mirroring MXNET_REGISTER_NDARRAY_FUN)
+# ---------------------------------------------------------------------------
+
+
+def _onehot_shape(params, in_shapes):
+    ind, out_like = in_shapes
+    return in_shapes, [out_like], []
+
+
+register_op(OpDef(
+    name="onehot_encode",
+    forward=lambda ctx, params, ind, out_like: jax.nn.one_hot(
+        ind.astype(jnp.int32), out_like.shape[1], dtype=out_like.dtype),
+    arguments=("indices", "out_like"),
+    infer_shape=_onehot_shape,
+    func_name="onehot_encode",
+))
+
+register_op(OpDef(
+    name="choose_element_0index",
+    forward=lambda ctx, params, lhs, rhs: jnp.take_along_axis(
+        lhs, rhs.astype(jnp.int32)[:, None], axis=1)[:, 0],
+    arguments=("lhs", "rhs"),
+    infer_shape=lambda params, in_shapes: (
+        in_shapes,
+        [None if in_shapes[0] is None else (in_shapes[0][0],)],
+        []),
+    func_name="choose_element_0index",
+    doc="pick lhs[i, rhs[i]] per row (used for eval metrics)",
+))
+
+
+def _fill_element_0index(ctx, params, lhs, mhs, rhs):
+    idx = rhs.astype(jnp.int32)
+    return lhs.at[jnp.arange(lhs.shape[0]), idx].set(mhs)
+
+
+register_op(OpDef(
+    name="fill_element_0index",
+    forward=_fill_element_0index,
+    arguments=("lhs", "mhs", "rhs"),
+    infer_shape=lambda params, in_shapes: (in_shapes, [in_shapes[0]], []),
+    func_name="fill_element_0index",
+))

@@ -1,0 +1,56 @@
+"""Random sampling API, analog of reference ``python/mxnet/random.py``.
+
+The reference keeps one seeded PRNG per device inside the ResourceManager
+(``src/resource.cc:76-200``); ``mx.random.seed`` reseeds all of them.  Here
+the global state is a JAX PRNG key that is split for every sampling call,
+so imperative sampling is reproducible under ``seed`` while jitted graph
+execution threads its own keys (see ``executor.py``).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from .context import Context
+from .ndarray import NDArray, imperative_invoke
+
+__all__ = ["seed", "uniform", "normal", "randn"]
+
+_state = {"key": jax.random.PRNGKey(0)}
+
+
+def seed(seed_state: int) -> None:
+    """Seed the global PRNG (reference ``random.py:seed`` → ``MXRandomSeed``)."""
+    _state["key"] = jax.random.PRNGKey(int(seed_state))
+
+
+def _next_key():
+    _state["key"], sub = jax.random.split(_state["key"])
+    return sub
+
+
+def uniform(low: float = 0.0, high: float = 1.0, shape=None,
+            ctx: Optional[Context] = None, out: Optional[NDArray] = None) -> NDArray:
+    if out is not None and shape is None:
+        shape = out.shape
+    if isinstance(shape, int):
+        shape = (shape,)
+    return imperative_invoke(
+        "_sample_uniform", [], {"low": low, "high": high, "shape": shape},
+        out=out, ctx=ctx)
+
+
+def normal(loc: float = 0.0, scale: float = 1.0, shape=None,
+           ctx: Optional[Context] = None, out: Optional[NDArray] = None) -> NDArray:
+    if out is not None and shape is None:
+        shape = out.shape
+    if isinstance(shape, int):
+        shape = (shape,)
+    return imperative_invoke(
+        "_sample_normal", [], {"loc": loc, "scale": scale, "shape": shape},
+        out=out, ctx=ctx)
+
+
+def randn(*shape, loc: float = 0.0, scale: float = 1.0, ctx=None) -> NDArray:
+    return normal(loc=loc, scale=scale, shape=tuple(shape), ctx=ctx)

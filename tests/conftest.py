@@ -1,0 +1,19 @@
+"""Test configuration: run the whole suite on a virtual 8-device CPU mesh.
+
+Mirrors the reference's test strategy (SURVEY.md §4): real stack, local
+devices, exact-arithmetic assertions — multi-chip behavior is validated on
+host-platform virtual devices the way the reference validates distributed
+kvstore with all workers on localhost.
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The axon TPU plugin overrides JAX_PLATFORMS at registration time, so the
+# config knob must be set programmatically before the backend initializes.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

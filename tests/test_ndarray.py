@@ -1,0 +1,242 @@
+"""NDArray unit tests — modeled on reference tests/python/unittest/test_ndarray.py."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def reldiff(a, b):
+    a, b = np.asarray(a, np.float64), np.asarray(b, np.float64)
+    diff = np.abs(a - b).sum()
+    norm = np.abs(a).sum() + np.abs(b).sum()
+    return diff / (norm + 1e-8)
+
+
+def test_creation():
+    a = nd.zeros((3, 4))
+    assert a.shape == (3, 4)
+    assert a.dtype == np.float32
+    assert np.all(a.asnumpy() == 0)
+    b = nd.ones((2, 2), dtype="float64")
+    assert b.asnumpy().dtype == np.float64
+    c = nd.full((2,), 7.0)
+    assert np.all(c.asnumpy() == 7)
+    d = nd.array([[1, 2], [3, 4]])
+    assert d.shape == (2, 2)
+    e = nd.arange(5)
+    assert np.allclose(e.asnumpy(), np.arange(5))
+
+
+def test_elementwise_binary():
+    rng = np.random.RandomState(0)
+    x = rng.rand(4, 5).astype(np.float32)
+    y = rng.rand(4, 5).astype(np.float32)
+    a, b = nd.array(x), nd.array(y)
+    assert reldiff((a + b).asnumpy(), x + y) < 1e-6
+    assert reldiff((a - b).asnumpy(), x - y) < 1e-6
+    assert reldiff((a * b).asnumpy(), x * y) < 1e-6
+    assert reldiff((a / b).asnumpy(), x / y) < 1e-5
+    assert reldiff((a ** b).asnumpy(), x ** y) < 1e-5
+
+
+def test_scalar_ops():
+    x = np.arange(6, dtype=np.float32).reshape(2, 3)
+    a = nd.array(x)
+    assert np.allclose((a + 2).asnumpy(), x + 2)
+    assert np.allclose((2 + a).asnumpy(), x + 2)
+    assert np.allclose((a - 2).asnumpy(), x - 2)
+    assert np.allclose((2 - a).asnumpy(), 2 - x)
+    assert np.allclose((a * 3).asnumpy(), x * 3)
+    assert np.allclose((1.0 / (a + 1)).asnumpy(), 1.0 / (x + 1))
+    assert np.allclose((-a).asnumpy(), -x)
+
+
+def test_inplace_ops():
+    x = np.ones((2, 3), dtype=np.float32)
+    a = nd.array(x)
+    v0 = a.version
+    a += 2
+    assert np.all(a.asnumpy() == 3)
+    assert a.version > v0
+    a *= 2
+    assert np.all(a.asnumpy() == 6)
+    a /= 3
+    assert np.all(a.asnumpy() == 2)
+    a -= 1
+    assert np.all(a.asnumpy() == 1)
+
+
+def test_setitem_getitem():
+    a = nd.zeros((4, 3))
+    a[:] = 2
+    assert np.all(a.asnumpy() == 2)
+    a[1:3] = 5
+    expect = np.full((4, 3), 2, np.float32)
+    expect[1:3] = 5
+    assert np.all(a.asnumpy() == expect)
+    row = a[1]
+    assert row.shape == (3,)
+    assert np.all(row.asnumpy() == 5)
+
+
+def test_view_write_through():
+    # Slice views share storage: writes through the view appear in the parent
+    # (reference Chunk semantics, ndarray.h:227-261)
+    a = nd.zeros((4, 3))
+    s = a.slice(1, 3)
+    s[:] = 7
+    expect = np.zeros((4, 3), np.float32)
+    expect[1:3] = 7
+    assert np.all(a.asnumpy() == expect)
+    # write through parent visible in view
+    a[:] = 1
+    assert np.all(s.asnumpy() == 1)
+
+
+def test_reshape_view():
+    a = nd.array(np.arange(6, dtype=np.float32))
+    r = a.reshape((2, 3))
+    assert r.shape == (2, 3)
+    r[:] = 0
+    assert np.all(a.asnumpy() == 0)
+    r2 = a.reshape((3, -1))
+    assert r2.shape == (3, 2)
+
+
+def test_unary_math():
+    x = np.random.RandomState(1).rand(3, 4).astype(np.float32) + 0.5
+    a = nd.array(x)
+    assert reldiff(nd.exp(a).asnumpy(), np.exp(x)) < 1e-6
+    assert reldiff(nd.log(a).asnumpy(), np.log(x)) < 1e-6
+    assert reldiff(nd.sqrt(a).asnumpy(), np.sqrt(x)) < 1e-6
+    assert reldiff(nd.square(a).asnumpy(), x * x) < 1e-6
+    assert reldiff(nd.rsqrt(a).asnumpy(), 1 / np.sqrt(x)) < 1e-5
+    assert reldiff(nd.sign(nd.array(x - 1.0)).asnumpy(), np.sign(x - 1.0)) < 1e-6
+    assert reldiff(nd.cos(a).asnumpy(), np.cos(x)) < 1e-6
+    assert reldiff(nd.sin(a).asnumpy(), np.sin(x)) < 1e-6
+
+
+def test_reductions():
+    x = np.random.RandomState(2).rand(3, 4).astype(np.float32)
+    a = nd.array(x)
+    assert reldiff(nd.sum(a).asnumpy(), x.sum()) < 1e-5
+    assert reldiff(nd.max(a).asnumpy(), x.max()) < 1e-6
+    assert reldiff(nd.min(a).asnumpy(), x.min()) < 1e-6
+    assert reldiff(nd.norm(a).asnumpy(), np.sqrt((x * x).sum())) < 1e-5
+    assert nd.sum(a).shape == (1,)
+    out = nd.sum_axis(a, axis=(1,))
+    assert out.shape == (3,)
+    assert reldiff(out.asnumpy(), x.sum(axis=1)) < 1e-5
+    out = nd.sum_axis(a, axis=(0,), keepdims=True)
+    assert out.shape == (1, 4)
+
+
+def test_dot_transpose():
+    rng = np.random.RandomState(3)
+    x = rng.rand(4, 5).astype(np.float32)
+    y = rng.rand(5, 6).astype(np.float32)
+    o = nd.dot(nd.array(x), nd.array(y))
+    assert o.shape == (4, 6)
+    assert reldiff(o.asnumpy(), x @ y) < 1e-5
+    t = nd.transpose(nd.array(x))
+    assert t.shape == (5, 4)
+    assert np.allclose(t.asnumpy(), x.T)
+
+
+def test_matrix_misc():
+    x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    a = nd.array(x)
+    e = nd.expand_dims(a, axis=1)
+    assert e.shape == (2, 1, 3, 4)
+    s = nd.slice_axis(a, axis=1, begin=1, end=3)
+    assert s.shape == (2, 2, 4)
+    assert np.allclose(s.asnumpy(), x[:, 1:3])
+    f = nd.flip(a, axis=2)
+    assert np.allclose(f.asnumpy(), x[:, :, ::-1])
+    c = nd.clip(a, a_min=3.0, a_max=10.0)
+    assert np.allclose(c.asnumpy(), np.clip(x, 3, 10))
+
+
+def test_broadcast():
+    x = np.random.RandomState(4).rand(2, 1, 3).astype(np.float32)
+    a = nd.array(x)
+    b = nd.broadcast_axis(a, axis=(1,), size=(4,))
+    assert b.shape == (2, 4, 3)
+    y = np.random.RandomState(5).rand(1, 4, 3).astype(np.float32)
+    out = nd.broadcast_plus(a, nd.array(y))
+    assert out.shape == (2, 4, 3)
+    assert reldiff(out.asnumpy(), x + y) < 1e-6
+
+
+def test_choose_onehot():
+    x = np.random.RandomState(6).rand(4, 5).astype(np.float32)
+    idx = np.array([0, 2, 4, 1], np.float32)
+    picked = nd.choose_element_0index(nd.array(x), nd.array(idx))
+    assert np.allclose(picked.asnumpy(), x[np.arange(4), idx.astype(int)])
+    oh = nd.onehot_encode(nd.array(idx), nd.zeros((4, 5)))
+    expect = np.zeros((4, 5), np.float32)
+    expect[np.arange(4), idx.astype(int)] = 1
+    assert np.allclose(oh.asnumpy(), expect)
+
+
+def test_random_reproducible():
+    mx.random.seed(42)
+    a = mx.random.uniform(0, 1, (10,))
+    mx.random.seed(42)
+    b = mx.random.uniform(0, 1, (10,))
+    assert np.allclose(a.asnumpy(), b.asnumpy())
+    c = mx.random.normal(2.0, 3.0, (500, 50))
+    m = c.asnumpy().mean()
+    assert abs(m - 2.0) < 0.1
+    # out= variant
+    out = nd.zeros((10,))
+    mx.random.uniform(-1, 1, out=out)
+    assert out.asnumpy().min() >= -1 and out.asnumpy().max() <= 1
+
+
+def test_save_load(tmp_path):
+    fname = str(tmp_path / "nd.bin")
+    a = nd.array(np.arange(6, np.float32).reshape(2, 3) if False else np.arange(6, dtype=np.float32).reshape(2, 3))
+    b = nd.ones((3,))
+    nd.save(fname, [a, b])
+    loaded = nd.load(fname)
+    assert isinstance(loaded, list) and len(loaded) == 2
+    assert np.allclose(loaded[0].asnumpy(), a.asnumpy())
+    nd.save(fname, {"weight": a, "bias": b})
+    d = nd.load(fname)
+    assert set(d) == {"weight", "bias"}
+    assert np.allclose(d["bias"].asnumpy(), 1)
+
+
+def test_copyto_context():
+    a = nd.array(np.arange(4, dtype=np.float32), ctx=mx.cpu(0))
+    b = nd.zeros((4,), ctx=mx.cpu(1))
+    a.copyto(b)
+    assert np.allclose(b.asnumpy(), a.asnumpy())
+    assert b.context == mx.cpu(1)
+    c = a.as_in_context(mx.cpu(2))
+    assert c.context == mx.cpu(2)
+    assert np.allclose(c.asnumpy(), a.asnumpy())
+
+
+def test_multiple_cpu_devices_exist():
+    # conftest forces an 8-device host mesh
+    import jax
+    assert len(jax.devices()) == 8
+
+
+def test_out_kwarg():
+    a = nd.array(np.arange(4, dtype=np.float32))
+    out = nd.zeros((4,))
+    nd.exp(a, out=out)
+    assert np.allclose(out.asnumpy(), np.exp(np.arange(4)))
+
+
+def test_wait_and_version():
+    a = nd.ones((2, 2))
+    a.wait_to_read()
+    nd.waitall()
+    v = a.version
+    a[:] = 3
+    assert a.version == v + 1
