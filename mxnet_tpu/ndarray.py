@@ -466,7 +466,19 @@ def imperative_invoke(opname: str, inputs: Sequence[NDArray], raw_params: Dict[s
     if op.needs_rng:
         from . import random as _random
         rng = _random._next_key()
-    opctx = OpContext(is_train=False, rng=rng)
+    # aux-state ops (BatchNorm, ...): trailing inputs beyond list_arguments
+    # are the aux arrays, mirroring how the executor binds arg + aux lists
+    n_args = len(op.list_arguments(params))
+    aux_names = op.list_aux_states(params)
+    aux = None
+    if aux_names and len(inputs) > n_args:
+        aux = {name: arr.data for name, arr in zip(aux_names, inputs[n_args:])}
+        inputs = inputs[:n_args]
+    elif aux_names:
+        raise MXNetError(
+            f"op {opname} has aux states {list(aux_names)}; pass them as "
+            f"trailing arguments after the {n_args} regular inputs")
+    opctx = OpContext(is_train=False, rng=rng, aux=aux)
     result = op.forward(opctx, params, *[x.data for x in inputs])
     results = list(result) if isinstance(result, (tuple, list)) else [result]
     outs = [NDArray(r, ctx=ctx) for r in results]

@@ -7,6 +7,7 @@ registration the reference does via ``MXNET_REGISTER_OP_PROPERTY`` /
 from .registry import (OP_REGISTRY, OpContext, OpDef, OpParam, get_op,
                        list_ops, register_op)
 from . import simple_ops  # noqa: F401  (registers simple ops)
+from . import nn_ops  # noqa: F401  (registers NN OperatorProperty ops)
 
 __all__ = ["OP_REGISTRY", "OpContext", "OpDef", "OpParam", "get_op",
            "list_ops", "register_op"]

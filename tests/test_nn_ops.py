@@ -1,0 +1,387 @@
+"""NN operator unit tests.
+
+Mirrors the reference's ``tests/python/unittest/test_operator.py`` strategy
+(SURVEY.md §4): per-op forward vs numpy and finite-difference gradient
+checking (``check_utils.py:45-120`` ``check_numeric_gradient``), adapted to
+JAX — analytic grads come from ``jax.grad`` over the registered forward.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.ops.registry import OpContext, get_op
+
+
+def invoke(opname, inputs, params=None, is_train=False, aux=None, rng=None):
+    op = get_op(opname)
+    p = op.parse_params(params or {})
+    ctx = OpContext(is_train=is_train, rng=rng, aux=aux)
+    out = op.forward(ctx, p, *[jnp.asarray(x) for x in inputs])
+    return out, ctx
+
+
+def numeric_grad(f, x, eps=1e-4):
+    """Finite differences, the analog of check_utils.numeric_grad."""
+    x = np.array(x, dtype=np.float64)  # copy: jax arrays are read-only views
+    g = np.zeros_like(x)
+    flat = x.reshape(-1)
+    gflat = g.reshape(-1)
+    for i in range(flat.size):
+        old = flat[i]
+        flat[i] = old + eps
+        fp = float(f(x.reshape(x.shape)))
+        flat[i] = old - eps
+        fm = float(f(x.reshape(x.shape)))
+        flat[i] = old
+        gflat[i] = (fp - fm) / (2 * eps)
+    return g
+
+
+def reldiff(a, b):
+    a, b = np.asarray(a), np.asarray(b)
+    denom = np.abs(a) + np.abs(b)
+    diff = np.abs(a - b)
+    return np.max(diff / np.maximum(denom, 1e-8)) if diff.size else 0.0
+
+
+def check_grad(opname, inputs, params=None, wrt=0, tol=1e-3, **kw):
+    """Compare jax.grad of sum(forward) against finite differences."""
+    op = get_op(opname)
+    p = op.parse_params(params or {})
+    arrays = [jnp.asarray(x, dtype=jnp.float64) for x in inputs]
+
+    def scalar_fn(*args):
+        ctx = OpContext(is_train=kw.get("is_train", False), aux=kw.get("aux"))
+        out = op.forward(ctx, p, *args)
+        if isinstance(out, tuple):
+            out = sum(jnp.sum(o) for o in out)
+        return jnp.sum(out)
+
+    analytic = jax.grad(scalar_fn, argnums=wrt)(*arrays)
+
+    def fd_fn(x):
+        args = list(arrays)
+        args[wrt] = jnp.asarray(x)
+        return scalar_fn(*args)
+
+    numeric = numeric_grad(fd_fn, np.asarray(arrays[wrt]))
+    assert reldiff(analytic, numeric) < tol, \
+        f"{opname}: grad mismatch {reldiff(analytic, numeric)}"
+
+
+def test_activation_forward():
+    x = np.array([[-1.0, 0.0, 2.0]], np.float32)
+    for act, ref in [("relu", np.maximum(x, 0)),
+                     ("sigmoid", 1 / (1 + np.exp(-x))),
+                     ("tanh", np.tanh(x)),
+                     ("softrelu", np.log1p(np.exp(x)))]:
+        out, _ = invoke("Activation", [x], {"act_type": act})
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5)
+
+
+def test_fully_connected():
+    rs = np.random.RandomState(0)
+    x = rs.randn(4, 6).astype(np.float32)
+    w = rs.randn(3, 6).astype(np.float32)
+    b = rs.randn(3).astype(np.float32)
+    out, _ = invoke("FullyConnected", [x, w, b], {"num_hidden": 3})
+    np.testing.assert_allclose(np.asarray(out), x @ w.T + b, rtol=1e-5)
+    check_grad("FullyConnected", [x, w, b], {"num_hidden": 3}, wrt=0)
+    check_grad("FullyConnected", [x, w, b], {"num_hidden": 3}, wrt=1)
+
+
+def test_fully_connected_flattens_trailing():
+    rs = np.random.RandomState(1)
+    x = rs.randn(2, 3, 4).astype(np.float32)
+    w = rs.randn(5, 12).astype(np.float32)
+    out, _ = invoke("FullyConnected", [x, w], {"num_hidden": 5, "no_bias": True})
+    np.testing.assert_allclose(np.asarray(out), x.reshape(2, 12) @ w.T, rtol=1e-5)
+
+
+def test_convolution_matches_manual():
+    rs = np.random.RandomState(2)
+    x = rs.randn(1, 1, 5, 5).astype(np.float32)
+    w = rs.randn(1, 1, 3, 3).astype(np.float32)
+    b = np.zeros(1, np.float32)
+    out, _ = invoke("Convolution", [x, w, b],
+                    {"kernel": (3, 3), "num_filter": 1})
+    # direct correlation
+    ref = np.zeros((1, 1, 3, 3), np.float32)
+    for i in range(3):
+        for j in range(3):
+            ref[0, 0, i, j] = np.sum(x[0, 0, i:i + 3, j:j + 3] * w[0, 0])
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4)
+
+
+def test_convolution_shapes_and_grad():
+    rs = np.random.RandomState(3)
+    x = rs.randn(2, 4, 8, 8).astype(np.float32)
+    w = rs.randn(6, 2, 3, 3).astype(np.float32)  # groups=2
+    b = rs.randn(6).astype(np.float32)
+    params = {"kernel": (3, 3), "num_filter": 6, "num_group": 2,
+              "stride": (2, 2), "pad": (1, 1)}
+    out, _ = invoke("Convolution", [x, w, b], params)
+    assert out.shape == (2, 6, 4, 4)
+    op = get_op("Convolution")
+    _, out_shapes, _ = op.do_infer_shape(op.parse_params(params),
+                                         [(2, 4, 8, 8), None, None])
+    assert out_shapes == [(2, 6, 4, 4)]
+    check_grad("Convolution", [x[:1, :, :4, :4], w, b], params, wrt=1, tol=5e-3)
+
+
+def test_deconvolution_inverts_stride():
+    rs = np.random.RandomState(4)
+    x = rs.randn(1, 3, 4, 4).astype(np.float32)
+    w = rs.randn(3, 2, 4, 4).astype(np.float32)  # (C_in, F, kh, kw)
+    out, _ = invoke("Deconvolution", [x, w],
+                    {"kernel": (4, 4), "stride": (2, 2), "pad": (1, 1),
+                     "num_filter": 2, "no_bias": True})
+    assert out.shape == (1, 2, 8, 8)
+    check_grad("Deconvolution", [x, w],
+               {"kernel": (4, 4), "stride": (2, 2), "pad": (1, 1),
+                "num_filter": 2, "no_bias": True}, wrt=0, tol=5e-3)
+
+
+def test_pooling():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    out, _ = invoke("Pooling", [x], {"kernel": (2, 2), "stride": (2, 2)})
+    np.testing.assert_allclose(np.asarray(out).ravel(), [5, 7, 13, 15])
+    out, _ = invoke("Pooling", [x], {"kernel": (2, 2), "stride": (2, 2),
+                                     "pool_type": "avg"})
+    np.testing.assert_allclose(np.asarray(out).ravel(), [2.5, 4.5, 10.5, 12.5])
+    out, _ = invoke("Pooling", [x], {"kernel": (1, 1), "global_pool": True,
+                                     "pool_type": "max"})
+    assert out.shape == (1, 1, 1, 1) and float(out[0, 0, 0, 0]) == 15.0
+
+
+def test_pooling_ceil_convention():
+    # reference pooling-inl.h:190-193 uses ceil: h=6,k=3,s=2 -> 3 (not 2)
+    x = np.arange(36, dtype=np.float32).reshape(1, 1, 6, 6)
+    out, _ = invoke("Pooling", [x], {"kernel": (3, 3), "stride": (2, 2)})
+    assert out.shape == (1, 1, 3, 3)
+    op = get_op("Pooling")
+    p = op.parse_params({"kernel": (3, 3), "stride": (2, 2)})
+    _, shapes, _ = op.do_infer_shape(p, [(1, 1, 6, 6)])
+    assert shapes == [(1, 1, 3, 3)]
+    # last window is partial (cols/rows 4..5): max of x[4:6,4:6] = 35
+    np.testing.assert_allclose(np.asarray(out)[0, 0, 2, 2], 35.0)
+
+
+def test_imperative_batchnorm_with_aux():
+    import mxnet_tpu.ndarray as nd
+    from mxnet_tpu.ndarray import imperative_invoke
+    x = nd.array(np.random.RandomState(0).randn(4, 3, 2, 2).astype(np.float32))
+    gamma, beta = nd.ones((3,)), nd.zeros((3,))
+    mean, var = nd.zeros((3,)), nd.ones((3,))
+    out = imperative_invoke("BatchNorm", [x, gamma, beta, mean, var], {})
+    assert out.shape == (4, 3, 2, 2)  # eval mode, uses moving stats
+    with pytest.raises(mx.MXNetError):
+        imperative_invoke("BatchNorm", [x, gamma, beta], {})
+
+
+def test_batchnorm_train_and_inference():
+    rs = np.random.RandomState(5)
+    x = rs.randn(8, 3, 4, 4).astype(np.float32) * 3 + 1
+    gamma = np.ones(3, np.float32)
+    beta = np.zeros(3, np.float32)
+    aux = {"moving_mean": jnp.zeros(3), "moving_var": jnp.ones(3)}
+    out, ctx = invoke("BatchNorm", [x, gamma, beta], {"fix_gamma": False},
+                      is_train=True, aux=aux)
+    out_np = np.asarray(out)
+    # normalized per channel
+    np.testing.assert_allclose(out_np.mean(axis=(0, 2, 3)), 0, atol=1e-4)
+    np.testing.assert_allclose(out_np.std(axis=(0, 2, 3)), 1, atol=1e-2)
+    # aux moving stats updated toward batch stats
+    mm = np.asarray(ctx.aux_updates["moving_mean"])
+    np.testing.assert_allclose(mm, 0.1 * x.mean(axis=(0, 2, 3)), rtol=1e-4)
+    # inference path uses moving stats
+    aux2 = {"moving_mean": jnp.asarray(x.mean(axis=(0, 2, 3))),
+            "moving_var": jnp.asarray(x.var(axis=(0, 2, 3)))}
+    out2, _ = invoke("BatchNorm", [x, gamma, beta], {"fix_gamma": False},
+                     is_train=False, aux=aux2)
+    np.testing.assert_allclose(np.asarray(out2), out_np, atol=1e-2)
+
+
+def test_dropout():
+    x = np.ones((100, 100), np.float32)
+    out, _ = invoke("Dropout", [x], {"p": 0.5}, is_train=True,
+                    rng=jax.random.PRNGKey(0))
+    arr = np.asarray(out)
+    frac_zero = (arr == 0).mean()
+    assert 0.4 < frac_zero < 0.6
+    kept = arr[arr != 0]
+    np.testing.assert_allclose(kept, 2.0, rtol=1e-5)  # inverted scaling
+    out_inf, _ = invoke("Dropout", [x], {"p": 0.5}, is_train=False)
+    np.testing.assert_allclose(np.asarray(out_inf), x)
+
+
+def test_structure_ops():
+    x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    out, _ = invoke("Flatten", [x])
+    assert out.shape == (2, 12)
+    out, _ = invoke("Reshape", [x], {"shape": (2, 12)})
+    assert out.shape == (2, 12)
+    out, _ = invoke("Reshape", [x], {"shape": (-1, 4)})
+    assert out.shape == (6, 4)
+    out, _ = invoke("SwapAxis", [x], {"dim1": 0, "dim2": 2})
+    assert out.shape == (4, 3, 2)
+    a = np.ones((2, 3)); b = 2 * np.ones((2, 5))
+    out, _ = invoke("Concat", [a, b], {"num_args": 2, "dim": 1})
+    assert out.shape == (2, 8)
+    outs, _ = invoke("SliceChannel", [x], {"num_outputs": 3, "axis": 1})
+    assert len(outs) == 3 and outs[0].shape == (2, 1, 4)
+    outs, _ = invoke("SliceChannel", [x], {"num_outputs": 3, "axis": 1,
+                                           "squeeze_axis": True})
+    assert outs[0].shape == (2, 4)
+    out, _ = invoke("Cast", [x], {"dtype": "int32"})
+    assert out.dtype == jnp.int32
+    out, _ = invoke("ElementWiseSum", [a, a, a], {"num_args": 3})
+    np.testing.assert_allclose(np.asarray(out), 3 * a)
+
+
+def test_blockgrad_stops_gradient():
+    x = jnp.asarray(np.random.randn(3, 3), dtype=jnp.float64)
+    op = get_op("BlockGrad")
+    g = jax.grad(lambda v: jnp.sum(op.forward(OpContext(), {}, v)))(x)
+    np.testing.assert_allclose(np.asarray(g), 0.0)
+
+
+def test_embedding():
+    w = np.arange(12, dtype=np.float32).reshape(4, 3)
+    idx = np.array([0, 2, 3], np.float32)
+    out, _ = invoke("Embedding", [idx, w], {"input_dim": 4, "output_dim": 3})
+    np.testing.assert_allclose(np.asarray(out), w[[0, 2, 3]])
+    # gradient wrt weight is scatter-add of ones
+    op = get_op("Embedding")
+    p = op.parse_params({"input_dim": 4, "output_dim": 3})
+    g = jax.grad(lambda w_: jnp.sum(op.forward(
+        OpContext(), p, jnp.asarray([0.0, 0.0, 2.0]), w_)))(jnp.asarray(w))
+    assert float(g[0, 0]) == 2.0 and float(g[2, 0]) == 1.0 and float(g[1, 0]) == 0.0
+
+
+def test_l2_normalization():
+    rs = np.random.RandomState(7)
+    x = rs.randn(4, 5).astype(np.float32)
+    out, _ = invoke("L2Normalization", [x])
+    norms = np.linalg.norm(np.asarray(out), axis=1)
+    np.testing.assert_allclose(norms, 1.0, rtol=1e-4)
+
+
+def test_lrn():
+    rs = np.random.RandomState(8)
+    x = np.abs(rs.randn(2, 5, 3, 3)).astype(np.float32)
+    out, _ = invoke("LRN", [x], {"nsize": 3})
+    # manual formula
+    sq = x ** 2
+    pad = np.pad(sq, ((0, 0), (1, 1), (0, 0), (0, 0)))
+    win = pad[:, 0:5] + pad[:, 1:6] + pad[:, 2:7]
+    ref = x * (2.0 + (1e-4 / 3) * win) ** -0.75
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4)
+
+
+def test_softmax_output_backward_semantics():
+    rs = np.random.RandomState(9)
+    data = jnp.asarray(rs.randn(4, 5), dtype=jnp.float64)
+    label = jnp.asarray([0.0, 1.0, 2.0, 3.0])
+    op = get_op("SoftmaxOutput")
+    p = op.parse_params({})
+    out = op.forward(OpContext(), p, data, label)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(jax.nn.softmax(data, axis=-1)))
+    # vjp with arbitrary cotangent returns (prob - onehot) regardless
+    _, vjp = jax.vjp(lambda d: op.forward(OpContext(), p, d, label), data)
+    (grad,) = vjp(jnp.full((4, 5), 123.0))
+    expect = np.array(jax.nn.softmax(data, axis=-1))
+    for i, l in enumerate([0, 1, 2, 3]):
+        expect[i, l] -= 1.0
+    np.testing.assert_allclose(np.asarray(grad), expect, rtol=1e-6)
+
+
+def test_softmax_output_ignore_label():
+    data = jnp.asarray(np.random.RandomState(0).randn(3, 4))
+    label = jnp.asarray([1.0, -1.0, 2.0])
+    op = get_op("SoftmaxOutput")
+    p = op.parse_params({"use_ignore": True, "ignore_label": -1})
+    _, vjp = jax.vjp(lambda d: op.forward(OpContext(), p, d, label), data)
+    (grad,) = vjp(jnp.ones((3, 4)))
+    np.testing.assert_allclose(np.asarray(grad)[1], 0.0)
+    assert np.abs(np.asarray(grad)[0]).sum() > 0
+
+
+def test_regression_outputs():
+    data = jnp.asarray([[1.0, 2.0], [3.0, 4.0]])
+    label = jnp.asarray([[0.0, 0.0], [0.0, 0.0]])
+    for name, fwd_ref, grad_ref in [
+        ("LinearRegressionOutput", np.asarray(data),
+         np.asarray(data) / 2),
+        ("MAERegressionOutput", np.asarray(data),
+         np.sign(np.asarray(data)) / 2),
+    ]:
+        op = get_op(name)
+        p = op.parse_params({})
+        out, vjp = jax.vjp(lambda d: op.forward(OpContext(), p, d, label), data)
+        np.testing.assert_allclose(np.asarray(out), fwd_ref)
+        (grad,) = vjp(jnp.zeros_like(data))  # head grad ignored
+        np.testing.assert_allclose(np.asarray(grad), grad_ref)
+
+
+def test_makeloss():
+    x = jnp.asarray([[1.0, 2.0]])
+    op = get_op("MakeLoss")
+    p = op.parse_params({"grad_scale": 0.5})
+    out, vjp = jax.vjp(lambda v: op.forward(OpContext(), p, v), x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x))
+    (grad,) = vjp(jnp.zeros_like(x))
+    np.testing.assert_allclose(np.asarray(grad), 0.5)
+
+
+def test_crop():
+    x = np.arange(36, dtype=np.float32).reshape(1, 1, 6, 6)
+    out, _ = invoke("Crop", [x], {"h_w": (2, 2), "offset": (1, 1)})
+    np.testing.assert_allclose(np.asarray(out).ravel(), [7, 8, 13, 14])
+    like = np.zeros((1, 1, 3, 3), np.float32)
+    out, _ = invoke("Crop", [x, like], {"num_args": 2, "center_crop": True})
+    assert out.shape == (1, 1, 3, 3)
+
+
+def test_upsampling_nearest():
+    x = np.arange(4, dtype=np.float32).reshape(1, 1, 2, 2)
+    out, _ = invoke("UpSampling", [x], {"scale": 2, "num_args": 1})
+    assert out.shape == (1, 1, 4, 4)
+    np.testing.assert_allclose(np.asarray(out)[0, 0],
+                               [[0, 0, 1, 1], [0, 0, 1, 1],
+                                [2, 2, 3, 3], [2, 2, 3, 3]])
+
+
+def test_roi_pooling():
+    x = np.arange(64, dtype=np.float32).reshape(1, 1, 8, 8)
+    rois = np.array([[0, 0, 0, 7, 7]], np.float32)  # full image
+    out, _ = invoke("ROIPooling", [x, rois],
+                    {"pooled_size": (2, 2), "spatial_scale": 1.0})
+    assert out.shape == (1, 1, 2, 2)
+    np.testing.assert_allclose(np.asarray(out)[0, 0], [[27, 31], [59, 63]])
+
+
+def test_leaky_relu_variants():
+    x = np.array([[-2.0, 3.0]], np.float32)
+    out, _ = invoke("LeakyReLU", [x], {"act_type": "leaky", "slope": 0.1})
+    np.testing.assert_allclose(np.asarray(out), [[-0.2, 3.0]], rtol=1e-6)
+    out, _ = invoke("LeakyReLU", [x], {"act_type": "elu", "slope": 1.0})
+    np.testing.assert_allclose(np.asarray(out), [[np.exp(-2) - 1, 3.0]], rtol=1e-5)
+    gamma = np.array([0.5], np.float32)
+    out, _ = invoke("LeakyReLU", [x.reshape(2, 1), gamma], {"act_type": "prelu"})
+    np.testing.assert_allclose(np.asarray(out).ravel(), [-1.0, 3.0])
+
+
+def test_infer_shape_through_registry():
+    op = get_op("Pooling")
+    p = op.parse_params({"kernel": (3, 3), "stride": (2, 2), "pad": (1, 1)})
+    # ceil convention: min(224+2-3+2-1, 225)//2 + 1 = 113
+    _, out, _ = op.do_infer_shape(p, [(2, 3, 224, 224)])
+    assert out == [(2, 3, 113, 113)]
+    op = get_op("Embedding")
+    p = op.parse_params({"input_dim": 100, "output_dim": 16})
+    ins, out, _ = op.do_infer_shape(p, [(32, 10), None])
+    assert ins[1] == (100, 16) and out == [(32, 10, 16)]
