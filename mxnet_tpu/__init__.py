@@ -25,10 +25,20 @@ from . import ops
 from . import ndarray
 from . import ndarray as nd
 from . import random
+from . import name
+from . import attribute
+from .attribute import AttrScope
+from . import symbol
+from . import symbol as sym
+from .symbol import Symbol, Variable, Group
+from . import executor
+from .executor import Executor
 
 __version__ = "0.1.0"
 
 __all__ = [
     "MXNetError", "Context", "cpu", "tpu", "gpu", "current_context",
-    "nd", "ndarray", "random", "ops",
+    "nd", "ndarray", "random", "ops", "symbol", "sym", "Symbol",
+    "Variable", "Group", "executor", "Executor", "AttrScope", "name",
+    "attribute",
 ]

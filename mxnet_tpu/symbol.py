@@ -1,0 +1,592 @@
+"""Symbol: declarative graph construction.
+
+TPU-native rebuild of the reference symbolic layer (``include/mxnet/
+symbolic.h:40-317``, ``src/symbol/symbol.cc``, ``python/mxnet/symbol.py``):
+
+* A :class:`Symbol` is a list of output *entries* ``(node, out_index)`` over
+  an immutable DAG of :class:`_Node` s (op + attrs + inputs) — the analog of
+  the reference ``Node``/``DataEntry`` structures (``static_graph.h:98-130``).
+* Composition (positional/kwargs, ``symbol.cc:302-433``), auto-created
+  variable inputs, auto-naming via :mod:`mxnet_tpu.name`, attribute scoping
+  via :mod:`mxnet_tpu.attribute`.
+* ``infer_shape``/``infer_type`` propagate over topo order like
+  ``StaticGraph::InferNodeShapes/InferNodeTypes`` (``static_graph.cc:59,160``),
+  with partial inference supported.
+* JSON save/load mirrors the reference graph serialization
+  (``symbolic.h:227-232``) so checkpoints have a stable text format.
+* ``bind``/``simple_bind`` hand the graph to :class:`mxnet_tpu.executor.
+  Executor`, where the whole graph is compiled to ONE XLA module — the
+  reference's StaticGraph→GraphExecutor memory planning
+  (``graph_executor.cc``) is replaced by XLA buffer assignment.
+
+Where the reference builds an explicit backward graph
+(``StaticGraph::MakeBackwardPass``, ``static_graph.cc:395-530``), here
+gradients are ``jax.vjp`` over the traced forward — gradient mirroring
+(``MXNET_BACKWARD_DO_MIRROR``) maps to ``jax.checkpoint`` applied per-node
+via the ``force_mirroring``/``__mirror_stage__`` attr.
+"""
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from . import attribute, name as _name_mod
+from .base import MXNetError
+from .context import Context
+from .ops.registry import OP_REGISTRY, OpDef, get_op
+
+__all__ = ["Symbol", "Variable", "Group", "load", "load_json", "var"]
+
+# attrs that are parameters vs annotation attrs: annotation attrs use the
+# __key__ convention like the reference (symbol attributes are stored
+# alongside op params in JSON)
+_RESERVED_PARAMS = ("name",)
+
+
+class _Node:
+    """One graph node: an operator application or a variable."""
+
+    __slots__ = ("op", "name", "attrs", "inputs")
+
+    def __init__(self, op: Optional[OpDef], name: str,
+                 attrs: Optional[Dict[str, str]] = None,
+                 inputs: Optional[List[Tuple["_Node", int]]] = None):
+        self.op = op
+        self.name = name
+        self.attrs: Dict[str, str] = dict(attrs or {})
+        self.inputs: List[Tuple[_Node, int]] = list(inputs or [])
+
+    @property
+    def is_variable(self) -> bool:
+        return self.op is None
+
+    def param_attrs(self) -> Dict[str, str]:
+        """Attrs that are op parameters (not __annotation__ attrs)."""
+        return {k: v for k, v in self.attrs.items()
+                if not (k.startswith("__") and k.endswith("__"))}
+
+    def anno_attrs(self) -> Dict[str, str]:
+        return {k[2:-2]: v for k, v in self.attrs.items()
+                if k.startswith("__") and k.endswith("__")}
+
+    def parsed_params(self) -> Dict[str, Any]:
+        return self.op.parse_params(self.param_attrs())
+
+    def num_outputs(self) -> int:
+        if self.is_variable:
+            return 1
+        return len(self.op.list_outputs(self.parsed_params()))
+
+    def aux_full_names(self) -> List[str]:
+        if self.is_variable:
+            return []
+        return [f"{self.name}_{a}"
+                for a in self.op.list_aux_states(self.parsed_params())]
+
+
+def _topo_sort(heads: Sequence[Tuple[_Node, int]]) -> List[_Node]:
+    """Post-DFS order (analog of StaticGraph::PostDFSOrder)."""
+    order: List[_Node] = []
+    visited = set()
+
+    def visit(node: _Node):
+        if id(node) in visited:
+            return
+        visited.add(id(node))
+        for (src, _) in node.inputs:
+            visit(src)
+        order.append(node)
+
+    for (n, _) in heads:
+        visit(n)
+    return order
+
+
+class Symbol:
+    """Symbolic multi-output expression (reference ``symbolic.h:40``)."""
+
+    def __init__(self, heads: List[Tuple[_Node, int]]):
+        self._heads = list(heads)
+
+    # ------------------------------------------------------------------
+    # Introspection
+    # ------------------------------------------------------------------
+
+    @property
+    def name(self) -> Optional[str]:
+        if len(self._heads) == 1:
+            return self._heads[0][0].name
+        return None
+
+    def _topo(self) -> List[_Node]:
+        return _topo_sort(self._heads)
+
+    def list_arguments(self) -> List[str]:
+        return [n.name for n in self._topo() if n.is_variable]
+
+    def list_outputs(self) -> List[str]:
+        out = []
+        for (node, idx) in self._heads:
+            if node.is_variable:
+                out.append(node.name)
+            else:
+                names = node.op.list_outputs(node.parsed_params())
+                suffix = names[idx]
+                out.append(f"{node.name}_{suffix}")
+        return out
+
+    def list_auxiliary_states(self) -> List[str]:
+        out = []
+        for n in self._topo():
+            out.extend(n.aux_full_names())
+        return out
+
+    def get_internals(self) -> "Symbol":
+        """All single outputs of every node (reference ``GetInternals``)."""
+        heads = []
+        for n in self._topo():
+            for i in range(n.num_outputs()):
+                heads.append((n, i))
+        return Symbol(heads)
+
+    def __getitem__(self, index: Union[int, str]) -> "Symbol":
+        if isinstance(index, str):
+            names = self.list_outputs()
+            if index not in names:
+                raise MXNetError(f"no output named {index}; have {names}")
+            index = names.index(index)
+        return Symbol([self._heads[index]])
+
+    def __len__(self) -> int:
+        return len(self._heads)
+
+    def __iter__(self):
+        return (self[i] for i in range(len(self._heads)))
+
+    def __repr__(self):
+        if self.name is not None:
+            return f"<Symbol {self.name}>"
+        return f"<Symbol group [{', '.join(self.list_outputs())}]>"
+
+    # ------------------------------------------------------------------
+    # Attributes (reference SetAttr/ListAttr, symbol.cc)
+    # ------------------------------------------------------------------
+
+    def attr(self, key: str) -> Optional[str]:
+        node = self._heads[0][0]
+        return node.attrs.get(f"__{key}__", node.attrs.get(key))
+
+    def _set_attr(self, **kwargs):
+        node = self._heads[0][0]
+        for k, v in kwargs.items():
+            if not isinstance(v, str):
+                raise MXNetError("attr values must be strings")
+            node.attrs[f"__{k}__"] = v
+
+    def attr_dict(self) -> Dict[str, Dict[str, str]]:
+        ret: Dict[str, Dict[str, str]] = {}
+        for n in self._topo():
+            d = dict(n.param_attrs())
+            d.update(n.anno_attrs())
+            if d:
+                ret[n.name] = d
+        return ret
+
+    def list_attr(self) -> Dict[str, str]:
+        return self._heads[0][0].anno_attrs()
+
+    # ------------------------------------------------------------------
+    # Arithmetic sugar (maps to registered simple ops, like the reference
+    # symbol.py operator overloads)
+    # ------------------------------------------------------------------
+
+    def _binop(self, other, opname: str, scalar_op: str, reverse=False):
+        if isinstance(other, Symbol):
+            lhs, rhs = (other, self) if reverse else (self, other)
+            return _apply_op(opname, [lhs, rhs], {}, None)
+        if isinstance(other, (int, float)):
+            return _apply_op(scalar_op, [self], {"scalar": str(float(other))}, None)
+        return NotImplemented
+
+    def __add__(self, o): return self._binop(o, "_plus", "_plus_scalar")
+    def __radd__(self, o): return self._binop(o, "_plus", "_plus_scalar")
+    def __sub__(self, o): return self._binop(o, "_minus", "_minus_scalar")
+    def __rsub__(self, o): return self._binop(o, "_minus", "_rminus_scalar", reverse=True)
+    def __mul__(self, o): return self._binop(o, "_mul", "_mul_scalar")
+    def __rmul__(self, o): return self._binop(o, "_mul", "_mul_scalar")
+    def __truediv__(self, o): return self._binop(o, "_div", "_div_scalar")
+    def __rtruediv__(self, o): return self._binop(o, "_div", "_rdiv_scalar", reverse=True)
+    def __pow__(self, o): return self._binop(o, "_power", "_power_scalar")
+    def __neg__(self): return self._binop(-1.0, "_mul", "_mul_scalar")
+
+    # ------------------------------------------------------------------
+    # Composition (reference symbol.cc:302-433 Compose)
+    # ------------------------------------------------------------------
+
+    def __call__(self, *args: "Symbol", **kwargs: "Symbol") -> "Symbol":
+        """Substitute this symbol's free variables with other symbols."""
+        arg_names = self.list_arguments()
+        sub: Dict[str, Symbol] = {}
+        if args:
+            if len(args) > len(arg_names):
+                raise MXNetError("too many positional arguments to compose")
+            for an, s in zip(arg_names, args):
+                sub[an] = s
+        for k, s in kwargs.items():
+            if k in sub:
+                raise MXNetError(f"duplicate composition argument {k}")
+            sub[k] = s
+        for k in sub:
+            if k not in arg_names:
+                raise MXNetError(f"compose: no variable named {k}")
+        # deep-copy graph with substitution
+        mapping: Dict[int, _Node] = {}
+
+        def clone(node: _Node) -> _Node:
+            if id(node) in mapping:
+                return mapping[id(node)]
+            if node.is_variable and node.name in sub:
+                rep_node, rep_idx = sub[node.name]._heads[0]
+                if rep_idx != 0 and rep_node.num_outputs() > 1:
+                    raise MXNetError("cannot substitute with non-first output")
+                mapping[id(node)] = rep_node
+                return rep_node
+            new = _Node(node.op, node.name, node.attrs,
+                        [(clone(s), i) for (s, i) in node.inputs])
+            mapping[id(node)] = new
+            return new
+
+        return Symbol([(clone(n), i) for (n, i) in self._heads])
+
+    # ------------------------------------------------------------------
+    # Shape / type inference (StaticGraph::InferNodeShapes/Types)
+    # ------------------------------------------------------------------
+
+    def infer_shape(self, *args, **kwargs):
+        arg_shapes, out_shapes, aux_shapes = self._infer_shape_impl(False, *args, **kwargs)
+        if arg_shapes is not None and any(s is None for s in arg_shapes):
+            unknown = [n for n, s in zip(self.list_arguments(), arg_shapes) if s is None]
+            raise MXNetError(f"cannot fully infer shapes; unknown for {unknown}. "
+                             "Use infer_shape_partial for partial inference.")
+        return arg_shapes, out_shapes, aux_shapes
+
+    def infer_shape_partial(self, *args, **kwargs):
+        return self._infer_shape_impl(True, *args, **kwargs)
+
+    def _infer_shape_impl(self, partial: bool, *args, **kwargs):
+        arg_names = self.list_arguments()
+        known: Dict[str, Tuple[int, ...]] = {}
+        if args:
+            for n, s in zip(arg_names, args):
+                if s is not None:
+                    known[n] = tuple(s)
+        for k, s in kwargs.items():
+            if s is not None:
+                known[k] = tuple(s)
+        topo = self._topo()
+        shapes: Dict[Tuple[int, int], Optional[Tuple[int, ...]]] = {}
+        aux_shapes: Dict[str, Optional[Tuple[int, ...]]] = {}
+        var_shapes: Dict[str, Optional[Tuple[int, ...]]] = dict(known)
+
+        for _sweep in range(2):  # two sweeps let late constraints back-fill
+            for node in topo:
+                if node.is_variable:
+                    shapes[(id(node), 0)] = var_shapes.get(node.name)
+                    continue
+                params = node.parsed_params()
+                in_shapes = [shapes.get((id(s), i)) for (s, i) in node.inputs]
+                try:
+                    new_in, out_s, aux_s = node.op.do_infer_shape(params, in_shapes)
+                except MXNetError:
+                    raise
+                except Exception as e:  # noqa: BLE001
+                    raise MXNetError(
+                        f"infer_shape error at node {node.name} ({node.op.name}): {e}"
+                    ) from e
+                # back-fill newly inferred input shapes into variables
+                for (src, i), s in zip(node.inputs, new_in):
+                    if s is not None:
+                        prev = shapes.get((id(src), i))
+                        if prev is not None and tuple(prev) != tuple(s):
+                            raise MXNetError(
+                                f"shape mismatch at {node.name}: {prev} vs {s}")
+                        shapes[(id(src), i)] = tuple(s)
+                        if src.is_variable:
+                            var_shapes[src.name] = tuple(s)
+                for i, s in enumerate(out_s):
+                    if s is not None:
+                        shapes[(id(node), i)] = tuple(s)
+                for aname, s in zip(node.aux_full_names(), aux_s):
+                    aux_shapes[aname] = None if s is None else tuple(s)
+
+        arg_out = [var_shapes.get(n) for n in arg_names]
+        head_out = [shapes.get((id(n), i)) for (n, i) in self._heads]
+        aux_out = [aux_shapes.get(n) for n in self.list_auxiliary_states()]
+        return arg_out, head_out, aux_out
+
+    def infer_type(self, *args, **kwargs):
+        arg_names = self.list_arguments()
+        known: Dict[str, np.dtype] = {}
+        if args:
+            for n, t in zip(arg_names, args):
+                if t is not None:
+                    known[n] = np.dtype(t)
+        for k, t in kwargs.items():
+            if t is not None:
+                known[k] = np.dtype(t)
+        topo = self._topo()
+        types: Dict[Tuple[int, int], Optional[np.dtype]] = {}
+        var_types: Dict[str, Optional[np.dtype]] = dict(known)
+        aux_types: Dict[str, Optional[np.dtype]] = {}
+        for node in topo:
+            if node.is_variable:
+                types[(id(node), 0)] = var_types.get(node.name, np.dtype(np.float32))
+                var_types.setdefault(node.name, np.dtype(np.float32))
+                continue
+            params = node.parsed_params()
+            in_types = [types.get((id(s), i)) for (s, i) in node.inputs]
+            new_in, out_t, aux_t = node.op.do_infer_type(params, in_types)
+            for (src, i), t in zip(node.inputs, new_in):
+                if t is not None and types.get((id(src), i)) is None:
+                    types[(id(src), i)] = np.dtype(t)
+                    if src.is_variable:
+                        var_types[src.name] = np.dtype(t)
+            for i, t in enumerate(out_t):
+                types[(id(node), i)] = None if t is None else np.dtype(t)
+            for aname, t in zip(node.aux_full_names(), aux_t):
+                aux_types[aname] = None if t is None else np.dtype(t)
+        arg_out = [var_types.get(n) for n in arg_names]
+        head_out = [types.get((id(n), i)) for (n, i) in self._heads]
+        aux_out = [aux_types.get(n, np.dtype(np.float32))
+                   for n in self.list_auxiliary_states()]
+        return arg_out, head_out, aux_out
+
+    # ------------------------------------------------------------------
+    # Serialization (reference Symbol::ToJSON, symbolic.h:227-232)
+    # ------------------------------------------------------------------
+
+    def tojson(self) -> str:
+        topo = self._topo()
+        node_ids = {id(n): i for i, n in enumerate(topo)}
+        nodes = []
+        for n in topo:
+            nodes.append({
+                "op": "null" if n.is_variable else n.op.name,
+                "name": n.name,
+                "attrs": dict(n.attrs),
+                "inputs": [[node_ids[id(s)], i] for (s, i) in n.inputs],
+            })
+        return json.dumps({
+            "nodes": nodes,
+            "arg_nodes": [i for i, n in enumerate(topo) if n.is_variable],
+            "heads": [[node_ids[id(n)], i] for (n, i) in self._heads],
+            "mxtpu_version": 1,
+        }, indent=2)
+
+    def save(self, fname: str) -> None:
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    # ------------------------------------------------------------------
+    # Gradient helper (reference Symbol::Grad — rarely used; autodiff is
+    # structural here).  Returns a Symbol is not supported; executors own
+    # gradients.  Kept for API parity.
+    # ------------------------------------------------------------------
+
+    def grad(self, wrt: Sequence[str]):
+        raise MXNetError(
+            "Symbol.grad is not supported: bind with args_grad instead "
+            "(gradients are computed by the executor via jax.vjp)")
+
+    # ------------------------------------------------------------------
+    # Binding
+    # ------------------------------------------------------------------
+
+    def bind(self, ctx: Context, args, args_grad=None, grad_req="write",
+             aux_states=None, group2ctx=None, shared_exec=None):
+        from .executor import Executor
+        return Executor(self, ctx, args, args_grad, grad_req, aux_states,
+                        group2ctx=group2ctx, shared_exec=shared_exec)
+
+    def simple_bind(self, ctx: Context, grad_req="write", type_dict=None,
+                    group2ctx=None, shared_exec=None, **kwargs):
+        """Infer shapes from kwargs, allocate arrays, bind
+        (reference ``symbol.py:630``)."""
+        from . import ndarray as nd
+        arg_shapes, _, aux_shapes = self.infer_shape(**kwargs)
+        if arg_shapes is None or any(s is None for s in arg_shapes):
+            raise MXNetError("simple_bind: cannot infer all argument shapes")
+        arg_types, _, aux_types = self.infer_type(
+            **{k: v for k, v in (type_dict or {}).items()})
+        args = {}
+        args_grad = {}
+        for aname, shape, dtype in zip(self.list_arguments(), arg_shapes, arg_types):
+            args[aname] = nd.zeros(shape, ctx=ctx, dtype=dtype)
+            if grad_req != "null":
+                args_grad[aname] = nd.zeros(shape, ctx=ctx, dtype=dtype)
+        aux_states = {
+            aname: nd.zeros(shape, ctx=ctx, dtype=dtype)
+            for aname, shape, dtype in zip(self.list_auxiliary_states(),
+                                           aux_shapes, aux_types)}
+        return self.bind(ctx, args, args_grad or None, grad_req, aux_states,
+                         group2ctx=group2ctx, shared_exec=shared_exec)
+
+
+# ---------------------------------------------------------------------------
+# Constructors
+# ---------------------------------------------------------------------------
+
+
+def _scope_attrs() -> Dict[str, str]:
+    """Current AttrScope attrs in stored (``__key__``) form."""
+    return {f"__{k}__": v for k, v in attribute.current().get(None).items()}
+
+
+def Variable(name: str, attr: Optional[Dict[str, str]] = None,
+             shape=None, lr_mult=None, wd_mult=None, dtype=None,
+             init=None) -> Symbol:
+    """Create a free variable (reference ``symbol.py:Variable``)."""
+    if not isinstance(name, str):
+        raise MXNetError("Variable name must be a string")
+    attrs = _scope_attrs()
+    attrs.update(
+        {f"__{k}__" if not (k.startswith("__") and k.endswith("__")) else k: v
+         for k, v in (attr or {}).items()})
+    if shape is not None:
+        attrs["__shape__"] = str(tuple(shape))
+    if lr_mult is not None:
+        attrs["__lr_mult__"] = str(lr_mult)
+    if wd_mult is not None:
+        attrs["__wd_mult__"] = str(wd_mult)
+    if dtype is not None:
+        attrs["__dtype__"] = str(np.dtype(dtype))
+    if init is not None:
+        attrs["__init__"] = init if isinstance(init, str) else init.dumps()
+    return Symbol([(_Node(None, name, attrs), 0)])
+
+
+var = Variable
+
+
+def Group(symbols: Sequence[Symbol]) -> Symbol:
+    """Group symbols into one multi-output symbol (reference ``Group``)."""
+    heads = []
+    for s in symbols:
+        heads.extend(s._heads)
+    return Symbol(heads)
+
+
+# ---------------------------------------------------------------------------
+# Auto-generated op constructors (reference _init_symbol_module)
+# ---------------------------------------------------------------------------
+
+
+def _apply_op(opname: str, sym_args: List[Symbol], str_params: Dict[str, str],
+              name: Optional[str], sym_kwargs: Optional[Dict[str, Symbol]] = None) -> Symbol:
+    op = get_op(opname)
+    params = op.parse_params(str_params)
+    arg_names = op.list_arguments(params)
+    hint = op.name.lower().lstrip("_")
+    name = _name_mod.current().get(name, hint)
+    # place positional symbols then kwargs then auto-create missing variables
+    assigned: Dict[str, Symbol] = {}
+    for an, s in zip(arg_names, sym_args):
+        assigned[an] = s
+    for k, s in (sym_kwargs or {}).items():
+        if k in assigned:
+            raise MXNetError(f"op {opname}: argument {k} given twice")
+        if k not in arg_names:
+            raise MXNetError(f"op {opname}: no argument named {k}; has {arg_names}")
+        assigned[k] = s
+    inputs: List[Tuple[_Node, int]] = []
+    for an in arg_names:
+        if an in assigned:
+            s = assigned[an]
+            if len(s._heads) != 1:
+                raise MXNetError(f"op {opname}: argument {an} must be single-output")
+            inputs.append(s._heads[0])
+        else:
+            # auto-create variable like the reference compose does
+            inputs.append((_Node(None, f"{name}_{an}",
+                                 _scope_attrs()), 0))
+    attrs = _scope_attrs()
+    attrs.update({k: str(v) for k, v in str_params.items()})
+    node = _Node(op, name, attrs, inputs)
+    n_out = node.num_outputs()
+    return Symbol([(node, i) for i in range(n_out)])
+
+
+def _make_symbol_function(opname: str, func_name: str):
+    op = get_op(opname)
+
+    def fn(*args, **kwargs):
+        name = kwargs.pop("name", None)
+        attr = kwargs.pop("attr", None)
+        sym_args = []
+        pos_scalars = []
+        for a in args:
+            if isinstance(a, Symbol):
+                sym_args.append(a)
+            else:
+                pos_scalars.append(a)
+        sym_kwargs = {}
+        str_params = {}
+        for k, v in kwargs.items():
+            if isinstance(v, Symbol):
+                sym_kwargs[k] = v
+            else:
+                str_params[k] = v if isinstance(v, str) else str(
+                    tuple(v) if isinstance(v, (list, tuple)) else v)
+        # positional scalars fill declared params in order (rare; parity with
+        # the generated ndarray functions)
+        if pos_scalars:
+            remaining = [p for p in op.params if p not in str_params]
+            for v in pos_scalars:
+                if not remaining:
+                    raise MXNetError(f"{func_name}: too many positional args")
+                str_params[remaining.pop(0)] = str(v)
+        out = _apply_op(opname, sym_args, str_params, name, sym_kwargs)
+        if attr:
+            out._heads[0][0].attrs.update(
+                {f"__{k}__": v for k, v in attr.items()})
+        return out
+
+    fn.__name__ = func_name
+    fn.__doc__ = op.doc or f"{opname} symbol constructor"
+    return fn
+
+
+def load_json(json_str: str) -> Symbol:
+    data = json.loads(json_str)
+    nodes: List[_Node] = []
+    for spec in data["nodes"]:
+        opname = spec["op"]
+        op = None if opname == "null" else get_op(opname)
+        node = _Node(op, spec["name"], spec.get("attrs", {}))
+        node.inputs = [(nodes[i], j) for (i, j) in spec["inputs"]]
+        nodes.append(node)
+    heads = [(nodes[i], j) for (i, j) in data["heads"]]
+    return Symbol(heads)
+
+
+def load(fname: str) -> Symbol:
+    with open(fname) as f:
+        return load_json(f.read())
+
+
+def _init_symbol_module():
+    g = globals()
+    for opname, op in OP_REGISTRY.items():
+        fname = op.func_name or opname
+        if fname in ("Variable", "Group", "load", "load_json"):
+            continue
+        g[fname] = _make_symbol_function(opname, fname)
+        if opname != fname and opname not in g:
+            g[opname] = g[fname]
+        if not fname.startswith("_") and fname not in __all__:
+            __all__.append(fname)
+
+
+_init_symbol_module()
