@@ -33,6 +33,18 @@ from . import symbol as sym
 from .symbol import Symbol, Variable, Group
 from . import executor
 from .executor import Executor
+from . import initializer
+from .initializer import Initializer, Uniform, Normal, Xavier, Orthogonal
+from . import lr_scheduler
+from . import optimizer
+from .optimizer import Optimizer
+from . import metric
+from . import callback
+from . import io
+from . import kvstore
+from . import executor_manager
+from . import model
+from .model import FeedForward, save_checkpoint, load_checkpoint
 
 __version__ = "0.1.0"
 
@@ -40,5 +52,8 @@ __all__ = [
     "MXNetError", "Context", "cpu", "tpu", "gpu", "current_context",
     "nd", "ndarray", "random", "ops", "symbol", "sym", "Symbol",
     "Variable", "Group", "executor", "Executor", "AttrScope", "name",
-    "attribute",
+    "attribute", "initializer", "optimizer", "metric", "callback", "io",
+    "kvstore", "executor_manager", "model", "FeedForward", "lr_scheduler",
+    "Initializer", "Uniform", "Normal", "Xavier", "Orthogonal", "Optimizer",
+    "save_checkpoint", "load_checkpoint",
 ]

@@ -1,0 +1,239 @@
+"""Weight initializers.
+
+Rebuild of the reference ``python/mxnet/initializer.py``: an
+:class:`Initializer` is called with ``(name, arr)`` and dispatches on the
+parameter name pattern (bias→0, gamma→1, beta→0, moving stats→0/1, else
+weight rule) — ``initializer.py:16-84``.
+"""
+from __future__ import annotations
+
+import json
+import math
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .base import MXNetError
+from .ndarray import NDArray
+
+__all__ = ["Initializer", "Uniform", "Normal", "Orthogonal", "Xavier",
+           "MSRAPrelu", "Load", "Mixed", "One", "Zero", "Constant"]
+
+
+class Initializer:
+    """Base: name-pattern dispatch (reference ``initializer.py:16``)."""
+
+    def __call__(self, name: str, arr: NDArray) -> None:
+        if not isinstance(name, str):
+            raise TypeError("name must be a string")
+        if name.startswith("upsampling"):
+            self._init_bilinear(name, arr)
+        elif name.endswith("bias"):
+            self._init_bias(name, arr)
+        elif name.endswith("gamma"):
+            self._init_gamma(name, arr)
+        elif name.endswith("beta"):
+            self._init_beta(name, arr)
+        elif name.endswith("weight"):
+            self._init_weight(name, arr)
+        elif name.endswith("moving_mean"):
+            self._init_zero(name, arr)
+        elif name.endswith("moving_var"):
+            self._init_one(name, arr)
+        elif name.endswith("moving_avg"):
+            self._init_zero(name, arr)
+        else:
+            self._init_default(name, arr)
+
+    def _init_bilinear(self, name, arr):
+        # fixed bilinear-upsampling kernel (reference _init_bilinear)
+        shape = arr.shape
+        weight = np.zeros(int(np.prod(shape)), dtype=np.float32)
+        f = np.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(int(np.prod(shape))):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        arr[:] = weight.reshape(shape)
+
+    def _init_zero(self, name, arr):
+        arr[:] = 0.0
+
+    def _init_one(self, name, arr):
+        arr[:] = 1.0
+
+    def _init_bias(self, name, arr):
+        arr[:] = 0.0
+
+    def _init_gamma(self, name, arr):
+        arr[:] = 1.0
+
+    def _init_beta(self, name, arr):
+        arr[:] = 0.0
+
+    def _init_weight(self, name, arr):
+        raise NotImplementedError("virtual _init_weight")
+
+    def _init_default(self, name, arr):
+        raise MXNetError(
+            f"Unknown initialization pattern for {name!r}: parameter names "
+            "should end with weight/bias/gamma/beta/moving_mean/moving_var")
+
+    def dumps(self) -> str:
+        return json.dumps([self.__class__.__name__.lower(),
+                           getattr(self, "_kwargs", {})])
+
+
+class Constant(Initializer):
+    """Fill every parameter with one value, bypassing name dispatch."""
+
+    def __init__(self, value: float):
+        self._kwargs = {"value": value}
+        self.value = value
+
+    def __call__(self, name: str, arr: NDArray) -> None:
+        arr[:] = self.value
+
+
+class Zero(Constant):
+    def __init__(self):
+        super().__init__(0.0)
+
+
+class One(Constant):
+    def __init__(self):
+        super().__init__(1.0)
+
+
+class Uniform(Initializer):
+    """U(-scale, scale) (reference ``initializer.py:150``)."""
+
+    def __init__(self, scale: float = 0.07):
+        self._kwargs = {"scale": scale}
+        self.scale = scale
+
+    def _init_weight(self, name, arr):
+        from . import random
+        random.uniform(-self.scale, self.scale, arr.shape, out=arr)
+
+
+class Normal(Initializer):
+    """N(0, sigma) (reference ``initializer.py:165``)."""
+
+    def __init__(self, sigma: float = 0.01):
+        self._kwargs = {"sigma": sigma}
+        self.sigma = sigma
+
+    def _init_weight(self, name, arr):
+        from . import random
+        random.normal(0, self.sigma, arr.shape, out=arr)
+
+
+class Orthogonal(Initializer):
+    """Orthogonal matrix init (reference ``initializer.py:179``)."""
+
+    def __init__(self, scale: float = 1.414, rand_type: str = "uniform"):
+        self._kwargs = {"scale": scale, "rand_type": rand_type}
+        self.scale = scale
+        self.rand_type = rand_type
+
+    def _init_weight(self, name, arr):
+        nout = arr.shape[0]
+        nin = int(np.prod(arr.shape[1:]))
+        if self.rand_type == "uniform":
+            tmp = np.random.uniform(-1.0, 1.0, (nout, nin))
+        else:
+            tmp = np.random.normal(0.0, 1.0, (nout, nin))
+        u, _, v = np.linalg.svd(tmp, full_matrices=False)
+        q = u if u.shape == tmp.shape else v
+        arr[:] = (self.scale * q).reshape(arr.shape)
+
+
+class Xavier(Initializer):
+    """Xavier/Glorot (reference ``initializer.py:216``)."""
+
+    def __init__(self, rnd_type: str = "uniform", factor_type: str = "avg",
+                 magnitude: float = 3):
+        self._kwargs = {"rnd_type": rnd_type, "factor_type": factor_type,
+                        "magnitude": magnitude}
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = magnitude
+
+    def _init_weight(self, name, arr):
+        shape = arr.shape
+        hw_scale = float(np.prod(shape[2:])) if len(shape) > 2 else 1.0
+        fan_in, fan_out = shape[1] * hw_scale if len(shape) > 1 else hw_scale, \
+            shape[0] * hw_scale
+        if self.factor_type == "avg":
+            factor = (fan_in + fan_out) / 2.0
+        elif self.factor_type == "in":
+            factor = fan_in
+        elif self.factor_type == "out":
+            factor = fan_out
+        else:
+            raise MXNetError("Xavier factor_type must be avg/in/out")
+        scale = math.sqrt(self.magnitude / factor)
+        from . import random
+        if self.rnd_type == "uniform":
+            random.uniform(-scale, scale, shape, out=arr)
+        elif self.rnd_type == "gaussian":
+            random.normal(0, scale, shape, out=arr)
+        else:
+            raise MXNetError("Xavier rnd_type must be uniform/gaussian")
+
+
+class MSRAPrelu(Xavier):
+    """Kaiming/MSRA init for PReLU nets."""
+
+    def __init__(self, factor_type: str = "avg", slope: float = 0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        super().__init__("gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+class Load:
+    """Init from a saved param dict with fallback (reference
+    ``initializer.py:85``)."""
+
+    def __init__(self, param: Dict[str, NDArray],
+                 default_init: Optional[Initializer] = None,
+                 verbose: bool = False):
+        self.param = {
+            (k[4:] if k.startswith(("arg:", "aux:")) else k): v
+            for k, v in param.items()}
+        self.default_init = default_init
+        self.verbose = verbose
+
+    def __call__(self, name: str, arr: NDArray) -> None:
+        if name in self.param:
+            src = self.param[name]
+            if tuple(src.shape) != tuple(arr.shape):
+                raise MXNetError(
+                    f"Load: shape mismatch for {name}: {src.shape} vs {arr.shape}")
+            arr[:] = src.asnumpy() if isinstance(src, NDArray) else src
+        else:
+            if self.default_init is None:
+                raise MXNetError(f"Load: no init for {name} and no default")
+            self.default_init(name, arr)
+
+
+class Mixed:
+    """Regex-pattern dispatch over multiple initializers (reference
+    ``initializer.py:127``)."""
+
+    def __init__(self, patterns: List[str], initializers: List[Initializer]):
+        import re
+        if len(patterns) != len(initializers):
+            raise MXNetError("Mixed: patterns and initializers length mismatch")
+        self.map = [(re.compile(p), i) for p, i in zip(patterns, initializers)]
+
+    def __call__(self, name: str, arr: NDArray) -> None:
+        for prog, init in self.map:
+            if prog.match(name):
+                init(name, arr)
+                return
+        raise MXNetError(
+            f"Mixed: parameter {name} did not match any pattern; add '.*' "
+            "as the last pattern for a default")

@@ -1,0 +1,154 @@
+"""KVStore: key-value parameter synchronization.
+
+Rebuild of the reference KVStore (``include/mxnet/kvstore.h``,
+``src/kvstore/kvstore_local.h``, ``python/mxnet/kvstore.py``).
+
+Single-process tiers (``local``/``device``): the reference groups pushed
+gradients by key and reduces on pinned CPU (``kvstore_local.h:135-236``) or
+GPU merge buffers (``kvstore_device.h:37-70``).  Here the reduce is one XLA
+add-N on the store's context — with multiple local TPU chips the
+executor-group keeps per-chip arrays and this store aggregates them, which
+XLA lowers to ICI transfers.  The ``dist*`` tiers (ps-lite in the
+reference, ``kvstore_dist.h``) map to `jax.distributed` + collectives and
+live in :mod:`mxnet_tpu.parallel.dist_kvstore`; :func:`create` dispatches
+there.
+"""
+from __future__ import annotations
+
+import pickle
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+
+from .base import MXNetError
+from .ndarray import NDArray, zeros
+
+__all__ = ["KVStore", "create"]
+
+
+def _key_list(key) -> List:
+    return list(key) if isinstance(key, (list, tuple)) else [key]
+
+
+def _value_list(key, value):
+    """Normalize (key, value) to (keys, list-of-lists-of-NDArray)."""
+    keys = _key_list(key)
+    if isinstance(value, NDArray):
+        value = [[value]]
+    elif isinstance(value, (list, tuple)) and value and isinstance(value[0], NDArray):
+        if len(keys) == 1:
+            value = [list(value)]
+        else:
+            value = [[v] for v in value]
+    elif isinstance(value, (list, tuple)):
+        value = [list(v) if isinstance(v, (list, tuple)) else [v] for v in value]
+    if len(keys) != len(value):
+        raise MXNetError(f"kvstore: {len(keys)} keys but {len(value)} value groups")
+    return keys, value
+
+
+class KVStore:
+    """Local single-process store (reference ``KVStoreLocal``)."""
+
+    def __init__(self, kind: str = "local"):
+        self._kind = kind
+        self._store: Dict[Any, NDArray] = {}
+        self._updater: Optional[Callable] = None
+        self._optimizer_blob: Optional[bytes] = None
+
+    @property
+    def type(self) -> str:
+        return self._kind
+
+    @property
+    def rank(self) -> int:
+        return 0
+
+    @property
+    def num_workers(self) -> int:
+        return 1
+
+    # ------------------------------------------------------------------
+
+    def init(self, key, value) -> None:
+        keys, values = _value_list(key, value)
+        for k, vgroup in zip(keys, values):
+            if k in self._store:
+                raise MXNetError(f"kvstore: key {k} already initialized")
+            v = vgroup[0]
+            self._store[k] = v.copy()
+
+    def push(self, key, value, priority: int = 0) -> None:
+        """Aggregate values into the store; run updater if set
+        (reference ``kvstore_local.h:67-101``)."""
+        import jax
+        keys, values = _value_list(key, value)
+        for k, vgroup in zip(keys, values):
+            if k not in self._store:
+                raise MXNetError(f"kvstore: key {k} not initialized")
+            # gather to the store's device then reduce — the analog of the
+            # GPU→pinned-CPU copies + ReduceSumCPU (kvstore_local.h:148-236)
+            dev = self._store[k].context.jax_device
+            parts = [jax.device_put(v.data, dev) for v in vgroup]
+            merged = parts[0]
+            for p in parts[1:]:
+                merged = merged + p
+            merged_nd = NDArray(merged, ctx=self._store[k].context)
+            if self._updater is not None:
+                self._updater(k, merged_nd, self._store[k])
+            else:
+                self._store[k]._write(self._store[k].data + merged)
+
+    def pull(self, key, out=None, priority: int = 0) -> None:
+        keys, outs = _value_list(key, out)
+        for k, ogroup in zip(keys, outs):
+            if k not in self._store:
+                raise MXNetError(f"kvstore: key {k} not initialized")
+            src = self._store[k]
+            for o in ogroup:
+                src.copyto(o)
+
+    # ------------------------------------------------------------------
+
+    def set_updater(self, updater: Callable) -> None:
+        """``updater(key, recv, local)`` (reference ``kvstore.h:134``)."""
+        self._updater = updater
+
+    def set_optimizer(self, optimizer) -> None:
+        """Use an optimizer for updates.  In the reference dist mode this
+        pickles the optimizer and broadcasts it to the servers
+        (``kvstore.py:251-254``); locally it installs ``get_updater``."""
+        from .optimizer import get_updater
+        self._optimizer_blob = pickle.dumps(optimizer)
+        self.set_updater(get_updater(optimizer))
+
+    def barrier(self) -> None:
+        pass
+
+    def send_command_to_servers(self, head: int, body: str) -> None:
+        pass
+
+    def save_optimizer_states(self, fname: str) -> None:
+        if self._optimizer_blob is None:
+            raise MXNetError("no optimizer set on kvstore")
+        with open(fname, "wb") as f:
+            f.write(self._optimizer_blob)
+
+    def load_optimizer_states(self, fname: str) -> None:
+        with open(fname, "rb") as f:
+            self.set_optimizer(pickle.loads(f.read()))
+
+
+_LOCAL_KINDS = ("local", "local_update_cpu", "local_allreduce_cpu",
+                "device", "local_allreduce_device")
+
+
+def create(name: str = "local") -> KVStore:
+    """Create a store by type (reference ``kvstore.cc:17-48``)."""
+    if not isinstance(name, str):
+        raise MXNetError("name must be a string")
+    if name in _LOCAL_KINDS:
+        return KVStore(name)
+    if name.startswith("dist"):
+        from .parallel.dist_kvstore import DistKVStore
+        return DistKVStore(name)
+    raise MXNetError(f"unknown kvstore type {name}; known: "
+                     f"{_LOCAL_KINDS + ('dist', 'dist_sync', 'dist_async')}")

@@ -1,0 +1,356 @@
+"""Optimizers.
+
+Rebuild of the reference ``python/mxnet/optimizer.py`` (registry + SGD:233,
+NAG:312, SGLD:360, ccSGD:425, Adam:506, AdaGrad:604, RMSProp:653,
+AdaDelta:727) and the C++ server-side optimizer (``src/optimizer/sgd-inl.h``
+— here every optimizer runs as XLA ops so there is no separate "cc" tier;
+``ccSGD`` is an alias with the reference's flat-momentum semantics).
+
+``update(index, weight, grad, state)`` mutates the bound weight NDArray —
+on TPU this is a fused XLA update; the Module/parallel layers instead use
+the functional form :meth:`Optimizer.apply` inside one jitted train step.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from .base import MXNetError, Registry
+from .lr_scheduler import LRScheduler
+from .ndarray import NDArray, zeros
+
+__all__ = ["Optimizer", "SGD", "NAG", "SGLD", "ccSGD", "Adam", "AdaGrad",
+           "RMSProp", "AdaDelta", "Test", "create", "get_updater", "register"]
+
+OPTIMIZER_REGISTRY: Registry = Registry("optimizer")
+
+
+def register(klass):
+    """Register an optimizer class (reference ``Optimizer.register``)."""
+    OPTIMIZER_REGISTRY.register(klass, name=klass.__name__.lower())
+    return klass
+
+
+class Optimizer:
+    """Base optimizer (reference ``optimizer.py:25``)."""
+
+    def __init__(self, rescale_grad: float = 1.0, param_idx2name: Optional[Dict[int, str]] = None,
+                 wd: float = 0.0, clip_gradient: Optional[float] = None,
+                 learning_rate: float = 0.01,
+                 lr_scheduler: Optional[LRScheduler] = None,
+                 sym=None, begin_num_update: int = 0,
+                 arg_names=None, **kwargs):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.lr_mult: Dict[str, float] = {}
+        self.wd_mult: Dict[str, float] = {}
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._index_update_count: Dict[int, int] = {}
+        self.clip_gradient = clip_gradient
+        if param_idx2name is None:
+            param_idx2name = {}
+        if not isinstance(param_idx2name, dict):
+            raise MXNetError("param_idx2name should be a dict of param indexes to names")
+        self.idx2name = dict(param_idx2name)
+        self.sym = sym
+        if sym is not None:
+            self.set_lr_wd_mult_from_sym(sym)
+
+    # pickle support for the kvstore broadcast path (reference
+    # kvstore.py:251-254 pickles the optimizer): the symbol is only used at
+    # construction to harvest lr/wd multipliers, so drop it from the state
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        state["sym"] = None
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+
+    # --- lr/wd multipliers (reference set_lr_mult/set_wd_mult) ---------
+
+    def set_lr_wd_mult_from_sym(self, sym) -> None:
+        attrs = sym.attr_dict()
+        for name, d in attrs.items():
+            if "lr_mult" in d:
+                self.lr_mult[name] = float(d["lr_mult"])
+            if "wd_mult" in d:
+                self.wd_mult[name] = float(d["wd_mult"])
+
+    def set_lr_mult(self, args_lr_mult: Dict[str, float]) -> None:
+        self.lr_mult.update(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult: Dict[str, float]) -> None:
+        self.wd_mult.update(args_wd_mult)
+
+    def _update_count(self, index) -> None:
+        if index not in self._index_update_count:
+            self._index_update_count[index] = self.begin_num_update
+        self._index_update_count[index] += 1
+        self.num_update = max(self._index_update_count[index], self.num_update)
+
+    def _get_lr(self, index) -> float:
+        lr = self.lr_scheduler(self.num_update) if self.lr_scheduler else self.lr
+        name = self.idx2name.get(index, index if isinstance(index, str) else None)
+        if name is not None and name in self.lr_mult:
+            lr *= self.lr_mult[name]
+        # reference convention: bias/gamma/beta default wd_mult 0 but lr 1;
+        # lr_mult defaults 1
+        return lr
+
+    def _get_wd(self, index) -> float:
+        wd = self.wd
+        name = self.idx2name.get(index, index if isinstance(index, str) else None)
+        if name is not None:
+            if name in self.wd_mult:
+                wd *= self.wd_mult[name]
+            elif name.endswith(("_gamma", "_beta", "_bias")):
+                # no weight decay on norm/bias params (reference set_wd_mult
+                # default: params not ending with _weight get wd_mult 0)
+                wd = 0.0
+        return wd
+
+    # --- state + update ------------------------------------------------
+
+    def create_state(self, index, weight: NDArray):
+        return None
+
+    def update(self, index, weight: NDArray, grad: NDArray, state) -> None:
+        raise NotImplementedError
+
+    def _preprocess_grad(self, grad_val):
+        g = grad_val * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        return g
+
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum and weight decay (reference ``optimizer.py:233``)."""
+
+    def __init__(self, momentum: float = 0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return zeros(weight.shape, weight.context, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        g = self._preprocess_grad(grad.data)
+        w = weight.data
+        if state is not None:
+            mom = self.momentum * state.data - lr * (g + wd * w)
+            state._write(mom)
+            weight._write(w + mom)
+        else:
+            weight._write(w - lr * (g + wd * w))
+
+
+@register
+class NAG(SGD):
+    """Nesterov accelerated SGD (reference ``optimizer.py:312``)."""
+
+    def update(self, index, weight, grad, state):
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        g = self._preprocess_grad(grad.data)
+        w = weight.data
+        if state is not None:
+            mom = self.momentum * state.data
+            gw = g + wd * w
+            mom = mom - lr * gw
+            state._write(mom)
+            weight._write(w + self.momentum * mom - lr * gw)
+        else:
+            weight._write(w - lr * (g + wd * w))
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic gradient Langevin dynamics (reference ``optimizer.py:360``)."""
+
+    def update(self, index, weight, grad, state):
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        g = self._preprocess_grad(grad.data)
+        w = weight.data
+        from . import random as _random
+        import jax
+        noise = jax.random.normal(_random._next_key(), w.shape,
+                                  dtype=w.dtype) * math.sqrt(lr)
+        weight._write(w - lr / 2 * (g + wd * w) + noise)
+
+
+@register
+class ccSGD(SGD):
+    """Alias of SGD; the reference's C++-side flat-buffer SGD
+    (``sgd-inl.h:102``) is unnecessary when updates are XLA ops."""
+
+
+@register
+class Adam(Optimizer):
+    """Adam (reference ``optimizer.py:506``)."""
+
+    def __init__(self, learning_rate: float = 0.001, beta1: float = 0.9,
+                 beta2: float = 0.999, epsilon: float = 1e-8,
+                 decay_factor: float = 1 - 1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.decay_factor = decay_factor
+        self.time = 0
+        self.time_first_index: Optional[int] = None
+
+    def create_state(self, index, weight):
+        self.time_first_index = None
+        return (zeros(weight.shape, weight.context, dtype=weight.dtype),
+                zeros(weight.shape, weight.context, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        lr = self._get_lr(index)
+        self._update_count(index)
+        t = self._index_update_count[index]
+        mean, variance = state
+        wd = self._get_wd(index)
+        g = self._preprocess_grad(grad.data) + wd * weight.data
+        m = self.beta1 * mean.data + (1.0 - self.beta1) * g
+        v = self.beta2 * variance.data + (1.0 - self.beta2) * g * g
+        mean._write(m)
+        variance._write(v)
+        coef1 = 1.0 - self.beta1 ** t
+        coef2 = 1.0 - self.beta2 ** t
+        lr_t = lr * math.sqrt(coef2) / coef1
+        weight._write(weight.data - lr_t * m / (jnp.sqrt(v) + self.epsilon))
+
+
+@register
+class AdaGrad(Optimizer):
+    """AdaGrad (reference ``optimizer.py:604``)."""
+
+    def __init__(self, eps: float = 1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return zeros(weight.shape, weight.context, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        g = self._preprocess_grad(grad.data)
+        history = state.data + g * g
+        state._write(history)
+        weight._write(weight.data - lr * (
+            g / jnp.sqrt(history + self.float_stable_eps) + wd * weight.data))
+
+
+@register
+class RMSProp(Optimizer):
+    """RMSProp with Graves-style momentum terms (reference
+    ``optimizer.py:653``)."""
+
+    def __init__(self, learning_rate: float = 0.002, gamma1: float = 0.95,
+                 gamma2: float = 0.9, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1 = gamma1
+        self.gamma2 = gamma2
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, weight.context, dtype=weight.dtype),  # n
+                zeros(weight.shape, weight.context, dtype=weight.dtype),  # g
+                zeros(weight.shape, weight.context, dtype=weight.dtype))  # delta
+
+    def update(self, index, weight, grad, state):
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        n, g_avg, delta = state
+        g = self._preprocess_grad(grad.data) + wd * weight.data
+        n_new = (1 - self.gamma1) * g * g + self.gamma1 * n.data
+        g_new = (1 - self.gamma1) * g + self.gamma1 * g_avg.data
+        n._write(n_new)
+        g_avg._write(g_new)
+        d = self.gamma2 * delta.data - lr * g / jnp.sqrt(
+            n_new - g_new * g_new + 1e-4)
+        delta._write(d)
+        weight._write(weight.data + d)
+
+
+@register
+class AdaDelta(Optimizer):
+    """AdaDelta (reference ``optimizer.py:727``)."""
+
+    def __init__(self, rho: float = 0.90, epsilon: float = 1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho = rho
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, weight.context, dtype=weight.dtype),
+                zeros(weight.shape, weight.context, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        wd = self._get_wd(index)
+        self._update_count(index)
+        g = self._preprocess_grad(grad.data)
+        acc_g, acc_delta = state
+        ag = self.rho * acc_g.data + (1.0 - self.rho) * g * g
+        acc_g._write(ag)
+        current_delta = (jnp.sqrt(acc_delta.data + self.epsilon) /
+                         jnp.sqrt(ag + self.epsilon)) * g
+        acc_delta._write(self.rho * acc_delta.data +
+                         (1.0 - self.rho) * current_delta * current_delta)
+        weight._write(weight.data - current_delta - wd * weight.data)
+
+
+@register
+class Test(Optimizer):
+    """Test optimizer: w += g (reference ``optimizer.py:781``)."""
+
+    def create_state(self, index, weight):
+        return zeros(weight.shape, weight.context, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        weight._write(weight.data + grad.data * self.rescale_grad)
+        state._write(weight.data)
+
+
+def create(name: str, rescale_grad: float = 1.0, **kwargs) -> Optimizer:
+    """Create an optimizer by registered name (reference ``create_optimizer``)."""
+    try:
+        klass = OPTIMIZER_REGISTRY.get(name)
+    except KeyError as e:
+        raise MXNetError(str(e)) from e
+    return klass(rescale_grad=rescale_grad, **kwargs)
+
+
+def get_updater(optimizer: Optimizer):
+    """Closure over per-index states (reference ``optimizer.py:get_updater``);
+    used by both local training loops and the KVStore server side."""
+    states: Dict[Any, Any] = {}
+
+    def updater(index, grad, weight):
+        if index not in states:
+            states[index] = optimizer.create_state(index, weight)
+        optimizer.update(index, weight, grad, states[index])
+
+    updater.states = states
+    return updater

@@ -1,0 +1,272 @@
+"""Training-stack tests: optimizers, metrics, io, kvstore, FeedForward.
+
+Mirrors the reference ``tests/python/train/test_mlp.py`` (small runs
+asserting an accuracy threshold) plus unit tests for the supporting
+modules (SURVEY.md §4).
+"""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+
+
+def make_blobs(n=400, num_classes=4, dim=10, seed=0):
+    rs = np.random.RandomState(seed)
+    centers = rs.randn(num_classes, dim) * 3
+    X = np.zeros((n, dim), np.float32)
+    y = np.zeros((n,), np.float32)
+    for i in range(n):
+        c = i % num_classes
+        X[i] = centers[c] + rs.randn(dim) * 0.5
+        y[i] = c
+    return X, y
+
+
+def mlp_symbol(num_classes=4):
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data=data, num_hidden=32, name="fc1")
+    net = sym.Activation(data=net, act_type="relu", name="relu1")
+    net = sym.FullyConnected(data=net, num_hidden=num_classes, name="fc2")
+    return sym.SoftmaxOutput(data=net, name="softmax")
+
+
+# ---------------------------------------------------------------------------
+# Optimizers
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("opt_name,lr", [
+    ("sgd", 0.1), ("adam", 0.1), ("adagrad", 1.0), ("rmsprop", 0.05),
+    ("adadelta", 0.01), ("nag", 0.1), ("ccsgd", 0.1), ("sgld", 0.01)])
+def test_optimizer_minimizes_quadratic(opt_name, lr):
+    opt = mx.optimizer.create(opt_name, learning_rate=lr)
+    updater = mx.optimizer.get_updater(opt)
+    w = nd.array(np.array([5.0, -3.0], np.float32))
+    start = np.abs(w.asnumpy()).max()
+    for _ in range(300):
+        g = nd.array(w.asnumpy())  # grad of 0.5*||w||^2
+        updater(0, g, w)
+    end = np.abs(w.asnumpy()).max()
+    # SGLD injects noise and AdaDelta self-tunes slowly: just require a
+    # large decrease; the deterministic optimizers must reach near zero
+    if opt_name in ("sgld", "adadelta"):
+        assert end < 0.5 * start, f"{opt_name} did not descend: {end}"
+    else:
+        assert end < 0.5, f"{opt_name} failed to converge: {end}"
+
+
+def test_sgd_momentum_matches_manual():
+    opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9, rescale_grad=1.0)
+    w = nd.array(np.array([1.0], np.float32))
+    state = opt.create_state(0, w)
+    g = nd.array(np.array([1.0], np.float32))
+    opt.update(0, w, g, state)
+    np.testing.assert_allclose(w.asnumpy(), [0.9], rtol=1e-6)
+    opt.update(0, w, g, state)
+    # mom = 0.9*(-0.1) - 0.1*1 = -0.19; w = 0.9 - 0.19 = 0.71
+    np.testing.assert_allclose(w.asnumpy(), [0.71], rtol=1e-6)
+
+
+def test_lr_scheduler():
+    sched = mx.lr_scheduler.FactorScheduler(step=10, factor=0.5)
+    sched.base_lr = 1.0
+    assert sched(5) == 1.0
+    assert sched(11) == 0.5
+    msched = mx.lr_scheduler.MultiFactorScheduler(step=[5, 15], factor=0.1)
+    msched.base_lr = 1.0
+    assert msched(3) == 1.0
+    assert abs(msched(7) - 0.1) < 1e-12
+    assert abs(msched(20) - 0.01) < 1e-12
+
+
+def test_optimizer_wd_skips_bias():
+    opt = mx.optimizer.SGD(learning_rate=0.1, wd=0.1,
+                           param_idx2name={0: "fc_weight", 1: "fc_bias"})
+    assert opt._get_wd(0) == pytest.approx(0.1)
+    assert opt._get_wd(1) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# Metrics
+# ---------------------------------------------------------------------------
+
+def test_accuracy_metric():
+    m = mx.metric.create("acc")
+    pred = nd.array(np.array([[0.9, 0.1], [0.2, 0.8], [0.6, 0.4]], np.float32))
+    label = nd.array(np.array([0, 1, 1], np.float32))
+    m.update([label], [pred])
+    assert m.get() == ("accuracy", pytest.approx(2.0 / 3.0))
+
+
+def test_topk_and_composite():
+    m = mx.metric.TopKAccuracy(top_k=2)
+    pred = nd.array(np.array([[0.1, 0.2, 0.7], [0.8, 0.15, 0.05]], np.float32))
+    label = nd.array(np.array([1.0, 2.0]))
+    m.update([label], [pred])
+    assert m.get()[1] == pytest.approx(0.5)
+    comp = mx.metric.create(["acc", "mse"])
+    assert isinstance(comp, mx.metric.CompositeEvalMetric)
+
+
+def test_custom_metric():
+    m = mx.metric.np(lambda label, pred: float(np.abs(label - pred.ravel()).sum()),
+                     name="l1")
+    m.update([nd.array([1.0, 2.0])], [nd.array([1.5, 2.0])])
+    assert m.get()[1] == pytest.approx(0.5)
+
+
+# ---------------------------------------------------------------------------
+# IO
+# ---------------------------------------------------------------------------
+
+def test_ndarray_iter():
+    X = np.arange(40, dtype=np.float32).reshape(10, 4)
+    y = np.arange(10, dtype=np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=3, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 4
+    assert batches[0].data[0].shape == (3, 4)
+    assert batches[-1].pad == 2
+    it.reset()
+    assert len(list(it)) == 4
+    it_d = mx.io.NDArrayIter(X, y, batch_size=3, last_batch_handle="discard")
+    assert len(list(it_d)) == 3
+
+
+def test_resize_and_prefetch_iter():
+    X = np.random.rand(20, 4).astype(np.float32)
+    base = mx.io.NDArrayIter(X, np.zeros(20, np.float32), batch_size=5)
+    r = mx.io.ResizeIter(mx.io.NDArrayIter(X, np.zeros(20, np.float32), batch_size=5), 7)
+    assert len(list(r)) == 7
+    p = mx.io.PrefetchingIter(base)
+    n = sum(1 for _ in p)
+    assert n == 4
+    p.reset()
+    assert sum(1 for _ in p) == 4
+
+
+def test_csv_iter(tmp_path):
+    data = np.random.rand(8, 3).astype(np.float32)
+    labels = np.arange(8, dtype=np.float32)
+    dpath, lpath = str(tmp_path / "d.csv"), str(tmp_path / "l.csv")
+    np.savetxt(dpath, data, delimiter=",")
+    np.savetxt(lpath, labels, delimiter=",")
+    it = mx.io.CSVIter(data_csv=dpath, data_shape=(3,), label_csv=lpath,
+                       batch_size=4)
+    b = next(it)
+    assert b.data[0].shape == (4, 3)
+    np.testing.assert_allclose(b.label[0].asnumpy(), [0, 1, 2, 3])
+
+
+# ---------------------------------------------------------------------------
+# KVStore (reference tests/python/unittest/test_kvstore.py)
+# ---------------------------------------------------------------------------
+
+def test_kvstore_push_pull_aggregation():
+    kv = mx.kvstore.create("local")
+    shape = (4, 4)
+    kv.init(3, nd.ones(shape))
+    # push from 4 "devices" and pull: default updater adds into stored value
+    kv.push(3, [nd.ones(shape)] * 4)
+    out = nd.zeros(shape)
+    kv.pull(3, out=out)
+    np.testing.assert_allclose(out.asnumpy(), 5.0)
+
+
+def test_kvstore_updater():
+    kv = mx.kvstore.create("local")
+    shape = (2,)
+    kv.init("w", nd.ones(shape))
+    kv.set_updater(lambda key, recv, local: local._write(
+        local.data - 0.5 * recv.data))
+    kv.push("w", nd.ones(shape))
+    out = nd.zeros(shape)
+    kv.pull("w", out=out)
+    np.testing.assert_allclose(out.asnumpy(), 0.5)
+
+
+def test_split_input_slice():
+    from mxnet_tpu.executor_manager import _split_input_slice
+    slices = _split_input_slice(10, [1, 1])
+    assert slices == [slice(0, 5), slice(5, 10)]
+    slices = _split_input_slice(9, [1, 2])
+    assert slices[0].stop - slices[0].start == 3
+    assert slices[1].stop - slices[1].start == 6
+
+
+# ---------------------------------------------------------------------------
+# Initializers
+# ---------------------------------------------------------------------------
+
+def test_initializer_patterns():
+    init = mx.Xavier()
+    w = nd.zeros((10, 20))
+    init("fc1_weight", w)
+    assert np.abs(w.asnumpy()).max() > 0
+    b = nd.ones((10,))
+    init("fc1_bias", b)
+    np.testing.assert_allclose(b.asnumpy(), 0.0)
+    g = nd.zeros((10,))
+    init("bn_gamma", g)
+    np.testing.assert_allclose(g.asnumpy(), 1.0)
+    mv = nd.zeros((10,))
+    init("bn_moving_var", mv)
+    np.testing.assert_allclose(mv.asnumpy(), 1.0)
+
+
+def test_mixed_initializer():
+    init = mx.initializer.Mixed([".*bias", ".*"],
+                                [mx.initializer.Constant(7), mx.Uniform(0.1)])
+    b = nd.zeros((4,))
+    init("fc_bias", b)
+    np.testing.assert_allclose(b.asnumpy(), 7.0)
+
+
+# ---------------------------------------------------------------------------
+# FeedForward end-to-end (the step-4 gate from SURVEY §7)
+# ---------------------------------------------------------------------------
+
+def test_feedforward_fit_predict_score():
+    X, y = make_blobs()
+    model = mx.FeedForward(mlp_symbol(), ctx=mx.cpu(), num_epoch=15,
+                           optimizer="sgd", learning_rate=0.5,
+                           numpy_batch_size=50,
+                           initializer=mx.Uniform(0.1))
+    model.fit(X, y, eval_metric="acc", kvstore=None)
+    acc = model.score(mx.io.NDArrayIter(X, y, batch_size=50))
+    assert acc > 0.95, f"train accuracy too low: {acc}"
+    preds = model.predict(X[:64])
+    assert preds.shape == (64, 4)
+    np.testing.assert_allclose(preds.sum(axis=1), 1.0, rtol=1e-4)
+
+
+def test_feedforward_checkpoint_roundtrip(tmp_path):
+    X, y = make_blobs(n=120)
+    prefix = str(tmp_path / "mlp")
+    model = mx.FeedForward(mlp_symbol(), ctx=mx.cpu(), num_epoch=3,
+                           optimizer="sgd", learning_rate=0.5,
+                           numpy_batch_size=40, initializer=mx.Uniform(0.1))
+    model.fit(X, y, kvstore=None,
+              epoch_end_callback=mx.callback.do_checkpoint(prefix))
+    assert os.path.exists(prefix + "-symbol.json")
+    assert os.path.exists(prefix + "-0003.params")
+    loaded = mx.FeedForward.load(prefix, 3, ctx=mx.cpu())
+    p1 = model.predict(X[:40])
+    p2 = loaded.predict(X[:40])
+    np.testing.assert_allclose(p1, p2, rtol=1e-4)
+
+
+def test_feedforward_multi_device_data_parallel():
+    # 2 virtual CPU devices, kvstore local — exercises executor_manager
+    X, y = make_blobs(n=200)
+    import jax
+    devs = [mx.Context("cpu", i) for i in range(min(2, len(jax.devices())))]
+    model = mx.FeedForward(mlp_symbol(), ctx=devs, num_epoch=10,
+                           optimizer="sgd", learning_rate=0.5,
+                           numpy_batch_size=50, initializer=mx.Uniform(0.1))
+    model.fit(X, y, kvstore="local")
+    acc = model.score(mx.io.NDArrayIter(X, y, batch_size=50))
+    assert acc > 0.9, f"multi-device accuracy too low: {acc}"
