@@ -45,6 +45,8 @@ from . import kvstore
 from . import executor_manager
 from . import model
 from .model import FeedForward, save_checkpoint, load_checkpoint
+from . import module as mod
+from . import module
 
 __version__ = "0.1.0"
 

@@ -420,14 +420,37 @@ class Symbol:
             raise MXNetError("simple_bind: cannot infer all argument shapes")
         arg_types, _, aux_types = self.infer_type(
             **{k: v for k, v in (type_dict or {}).items()})
+
+        def _req_for(aname):
+            if isinstance(grad_req, str):
+                return grad_req
+            if isinstance(grad_req, dict):
+                return grad_req.get(aname, "null")
+            return "write"
+
+        # with shared_exec, reuse its arrays where name+shape match — the
+        # analog of bucketing executors sharing one memory pool
+        # (executor_manager.py:288, graph_executor memory sharing)
+        def _shared(aname, shape, which):
+            if shared_exec is None:
+                return None
+            pool = getattr(shared_exec, which)
+            arr = pool.get(aname)
+            if arr is not None and tuple(arr.shape) == tuple(shape):
+                return arr
+            return None
+
         args = {}
         args_grad = {}
         for aname, shape, dtype in zip(self.list_arguments(), arg_shapes, arg_types):
-            args[aname] = nd.zeros(shape, ctx=ctx, dtype=dtype)
-            if grad_req != "null":
-                args_grad[aname] = nd.zeros(shape, ctx=ctx, dtype=dtype)
+            args[aname] = (_shared(aname, shape, "arg_dict")
+                           or nd.zeros(shape, ctx=ctx, dtype=dtype))
+            if _req_for(aname) != "null":
+                args_grad[aname] = (_shared(aname, shape, "grad_dict")
+                                    or nd.zeros(shape, ctx=ctx, dtype=dtype))
         aux_states = {
-            aname: nd.zeros(shape, ctx=ctx, dtype=dtype)
+            aname: (_shared(aname, shape, "aux_dict")
+                    or nd.zeros(shape, ctx=ctx, dtype=dtype))
             for aname, shape, dtype in zip(self.list_auxiliary_states(),
                                            aux_shapes, aux_types)}
         return self.bind(ctx, args, args_grad or None, grad_req, aux_states,

@@ -1,0 +1,173 @@
+"""Module API tests (reference tests/python/unittest test_module-era
+coverage + BucketingModule behavior)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+from mxnet_tpu.io import DataBatch, NDArrayIter
+
+
+def mlp_symbol(num_classes=4, num_hidden=32):
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data=data, num_hidden=num_hidden, name="fc1")
+    net = sym.Activation(data=net, act_type="relu", name="relu1")
+    net = sym.FullyConnected(data=net, num_hidden=num_classes, name="fc2")
+    return sym.SoftmaxOutput(data=net, name="softmax")
+
+
+def make_blobs(n=200, num_classes=4, dim=10, seed=0):
+    rs = np.random.RandomState(seed)
+    centers = rs.randn(num_classes, dim) * 3
+    X = np.zeros((n, dim), np.float32)
+    y = np.zeros((n,), np.float32)
+    for i in range(n):
+        c = i % num_classes
+        X[i] = centers[c] + rs.randn(dim) * 0.5
+        y[i] = c
+    return X, y
+
+
+def test_module_fit():
+    X, y = make_blobs()
+    train = NDArrayIter(X, y, batch_size=50)
+    mod = mx.mod.Module(mlp_symbol(), context=mx.cpu())
+    mod.fit(train, num_epoch=10, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5},
+            initializer=mx.Uniform(0.1))
+    score = mod.score(NDArrayIter(X, y, batch_size=50), "acc")
+    assert score[0][1] > 0.9
+
+
+def test_module_forward_backward_manual():
+    X, y = make_blobs(n=100)
+    mod = mx.mod.Module(mlp_symbol(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (20, 10))],
+             label_shapes=[("softmax_label", (20,))])
+    mod.init_params(mx.Uniform(0.1))
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    batch = DataBatch(data=[nd.array(X[:20])], label=[nd.array(y[:20])])
+    mod.forward_backward(batch)
+    mod.update()
+    out = mod.get_outputs()[0]
+    assert out.shape == (20, 4)
+    arg_params, aux_params = mod.get_params()
+    assert "fc1_weight" in arg_params
+
+
+def test_module_save_load_checkpoint(tmp_path):
+    X, y = make_blobs(n=100)
+    train = NDArrayIter(X, y, batch_size=25)
+    mod = mx.mod.Module(mlp_symbol(), context=mx.cpu())
+    mod.fit(train, num_epoch=2, initializer=mx.Uniform(0.1))
+    prefix = str(tmp_path / "mod")
+    mod.save_checkpoint(prefix, 2)
+    mod2 = mx.mod.Module.load(prefix, 2, context=mx.cpu())
+    mod2.bind(data_shapes=[("data", (25, 10))],
+              label_shapes=[("softmax_label", (25,))], for_training=False)
+    batch = DataBatch(data=[nd.array(X[:25])], label=[nd.array(y[:25])])
+    mod.forward(batch, is_train=False)
+    mod2.forward(batch, is_train=False)
+    np.testing.assert_allclose(mod.get_outputs()[0].asnumpy(),
+                               mod2.get_outputs()[0].asnumpy(), rtol=1e-5)
+
+
+def test_module_input_grads():
+    mod = mx.mod.Module(mlp_symbol(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (8, 10))],
+             label_shapes=[("softmax_label", (8,))],
+             inputs_need_grad=True)
+    mod.init_params(mx.Uniform(0.1))
+    batch = DataBatch(data=[nd.array(np.random.rand(8, 10).astype(np.float32))],
+                      label=[nd.array(np.zeros(8, np.float32))])
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    grads = mod.get_input_grads()
+    assert grads[0].shape == (8, 10)
+    assert np.abs(grads[0].asnumpy()).sum() > 0
+
+
+def test_bucketing_module():
+    # variable-length "sequences": one bucket per length
+    def sym_gen(seq_len):
+        data = sym.Variable("data")
+        net = sym.FullyConnected(data=data, num_hidden=8, name="fc_shared")
+        net = sym.FullyConnected(data=net, num_hidden=2, name="out")
+        net = sym.SoftmaxOutput(data=net, name="softmax")
+        return net, ("data",), ("softmax_label",)
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=10,
+                                 context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 10))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params(mx.Uniform(0.1))
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    for seq_len in (10, 5, 7, 10, 5):
+        batch = DataBatch(
+            data=[nd.array(np.random.rand(4, seq_len).astype(np.float32))],
+            label=[nd.array(np.zeros(4, np.float32))],
+            bucket_key=seq_len,
+            provide_data=[("data", (4, seq_len))],
+            provide_label=[("softmax_label", (4,))])
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        mod.update()
+    assert set(mod._buckets) == {10, 5, 7}
+    # parameters are shared: fc_shared weight identical across buckets
+    w10 = mod._buckets[10]._exec_group.execs[0].arg_dict["fc_shared_weight"]
+    w5 = mod._buckets[5]._exec_group.execs[0].arg_dict["fc_shared_weight"]
+    # note: shapes differ per bucket for fc_shared_weight (depends on input),
+    # so check the bucket-independent output layer instead
+    o10 = mod._buckets[10]._exec_group.execs[0].arg_dict["out_weight"].asnumpy()
+    o5 = mod._buckets[5]._exec_group.execs[0].arg_dict["out_weight"].asnumpy()
+    np.testing.assert_allclose(o10, o5, rtol=1e-5)
+
+
+def test_sequential_module():
+    X, y = make_blobs(n=100)
+    net1 = sym.FullyConnected(data=sym.Variable("data"), num_hidden=16,
+                              name="fc1")
+    net1 = sym.Activation(data=net1, act_type="relu", name="relu1")
+    net2 = sym.FullyConnected(data=sym.Variable("fc1_data"), num_hidden=4,
+                              name="fc2")
+    net2 = sym.SoftmaxOutput(data=net2, name="softmax")
+    seq = mx.mod.SequentialModule()
+    seq.add(mx.mod.Module(net1, context=mx.cpu(), label_names=[]),
+            auto_wiring=True)
+    seq.add(mx.mod.Module(net2, context=mx.cpu(),
+                          data_names=["fc1_data"]), take_labels=True,
+            auto_wiring=True)
+    train = NDArrayIter(X, y, batch_size=25)
+    seq.fit(train, num_epoch=8, initializer=mx.Uniform(0.1),
+            optimizer="sgd", optimizer_params={"learning_rate": 0.5})
+    score = seq.score(NDArrayIter(X, y, batch_size=25), "acc")
+    assert score[0][1] > 0.8
+
+
+def test_python_loss_module():
+    # PythonLossModule computing softmax grad host-side
+    def grad_func(scores, labels):
+        s = scores.asnumpy()
+        l = labels.asnumpy().astype(int)
+        p = np.exp(s - s.max(axis=1, keepdims=True))
+        p /= p.sum(axis=1, keepdims=True)
+        p[np.arange(len(l)), l] -= 1.0
+        return p.astype(np.float32)
+
+    net = sym.FullyConnected(data=sym.Variable("data"), num_hidden=2,
+                             name="fc")
+    X, y = make_blobs(n=80, num_classes=2, dim=6)
+    seq = mx.mod.SequentialModule()
+    seq.add(mx.mod.Module(net, context=mx.cpu(), label_names=[]),
+            auto_wiring=True)
+    seq.add(mx.mod.PythonLossModule(grad_func=grad_func,
+                                    data_names=("fc_data",)),
+            take_labels=True, auto_wiring=True)
+    train = NDArrayIter(X, y, batch_size=20)
+    seq.fit(train, num_epoch=10, initializer=mx.Uniform(0.1),
+            optimizer="sgd", optimizer_params={"learning_rate": 0.3})
+    # check the linear layer learned to separate
+    out = seq.get_outputs()[0].asnumpy()
+    assert out.shape[1] == 2
