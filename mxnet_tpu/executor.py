@@ -171,26 +171,35 @@ class Executor:
 
     # compiled program builders ----------------------------------------
 
+    def _prog(self, key: str, build):
+        """Fetch/compile a cached program.  The cache may be shared across
+        executors (bucketing), so entries are keyed by symbol identity and
+        pin the symbol — a shared bind over a *different* symbol compiles
+        its own program instead of silently reusing the wrong graph."""
+        full_key = (id(self._symbol), key)
+        ent = self._cache.get(full_key)
+        if ent is None or ent[0] is not self._symbol:
+            ent = (self._symbol, jax.jit(build()))
+            self._cache[full_key] = ent
+        return ent[1]
+
     def _get_fwd(self, is_train: bool):
-        key = f"fwd_{is_train}"
-        if key not in self._cache:
+        def build():
             def run(arg_vals, aux_vals, rng):
                 return self._eval(arg_vals, aux_vals, rng, is_train)
-            self._cache[key] = jax.jit(run)
-        return self._cache[key]
+            return run
+        return self._prog(f"fwd_{is_train}", build)
 
     def _get_fwd_internals(self, is_train: bool):
-        key = f"fwd_int_{is_train}"
-        if key not in self._cache:
+        def build():
             def run(arg_vals, aux_vals, rng):
                 return self._eval(arg_vals, aux_vals, rng, is_train,
                                   want_internals=True)
-            self._cache[key] = jax.jit(run)
-        return self._cache[key]
+            return run
+        return self._prog(f"fwd_int_{is_train}", build)
 
     def _get_fb(self):
-        key = "fb_" + ",".join(self._grad_names)
-        if key not in self._cache:
+        def build():
             grad_names = list(self._grad_names)
 
             def run(arg_vals, aux_vals, rng, out_grads):
@@ -210,8 +219,8 @@ class Executor:
                 (grads,) = vjp_fn(cot)
                 return heads, grads, auxu
 
-            self._cache[key] = jax.jit(run)
-        return self._cache[key]
+            return run
+        return self._prog("fb_" + ",".join(self._grad_names), build)
 
     # ------------------------------------------------------------------
     # Public API (reference executor.py)
@@ -307,9 +316,15 @@ class Executor:
                 dst._write(g.astype(dst.dtype))
 
     def _infer_head_shapes(self):
-        shapes = {n: tuple(a.shape) for n, a in self._arg_dict.items()}
-        _, out_shapes, _ = self._symbol.infer_shape(**shapes)
-        return out_shapes
+        # cached per arg-shape signature: default head grads must not pay
+        # full graph shape inference every backward() in the hot loop
+        sig = tuple(tuple(a.shape) for a in self._arg_dict.values())
+        if getattr(self, "_head_shape_sig", None) != sig:
+            shapes = {n: tuple(a.shape) for n, a in self._arg_dict.items()}
+            _, out_shapes, _ = self._symbol.infer_shape(**shapes)
+            self._head_shape_sig = sig
+            self._head_shapes = out_shapes
+        return self._head_shapes
 
     # dict/array accessors (reference executor.py properties) -----------
 

@@ -51,6 +51,11 @@ class KVStore:
     def __init__(self, kind: str = "local"):
         self._kind = kind
         self._store: Dict[Any, NDArray] = {}
+        # per-key merge buffer for the no-updater (allreduce) mode —
+        # mirrors the reference's MergePushValue buffers
+        # (kvstore_local.h:135-236): without an updater, pull must return
+        # the last merged push, never the stored init value mutated in place
+        self._merge_buf: Dict[Any, NDArray] = {}
         self._updater: Optional[Callable] = None
         self._optimizer_blob: Optional[bytes] = None
 
@@ -95,14 +100,17 @@ class KVStore:
             if self._updater is not None:
                 self._updater(k, merged_nd, self._store[k])
             else:
-                self._store[k]._write(self._store[k].data + merged)
+                self._merge_buf[k] = merged_nd
 
     def pull(self, key, out=None, priority: int = 0) -> None:
         keys, outs = _value_list(key, out)
         for k, ogroup in zip(keys, outs):
             if k not in self._store:
                 raise MXNetError(f"kvstore: key {k} not initialized")
-            src = self._store[k]
+            if self._updater is None and k in self._merge_buf:
+                src = self._merge_buf[k]
+            else:
+                src = self._store[k]
             for o in ogroup:
                 src.copyto(o)
 
@@ -127,14 +135,35 @@ class KVStore:
         pass
 
     def save_optimizer_states(self, fname: str) -> None:
+        """Persist the optimizer AND its updater's per-index states —
+        momentum/Adam moments must survive a save/load cycle."""
         if self._optimizer_blob is None:
             raise MXNetError("no optimizer set on kvstore")
+        from .optimizer import states_to_host
+        states = getattr(self._updater, "states", None) or {}
+        blob = {"optimizer": self._optimizer_blob,
+                "states": states_to_host(states)}
         with open(fname, "wb") as f:
-            f.write(self._optimizer_blob)
+            f.write(pickle.dumps(blob))
 
     def load_optimizer_states(self, fname: str) -> None:
+        from .optimizer import states_from_host
         with open(fname, "rb") as f:
-            self.set_optimizer(pickle.loads(f.read()))
+            blob = pickle.loads(f.read())
+        if not (isinstance(blob, dict) and "optimizer" in blob):
+            # pre-states format: a bare pickled optimizer
+            self.set_optimizer(blob)
+            return
+        self.set_optimizer(pickle.loads(blob["optimizer"]))
+
+        def ctx_for_key(k):
+            arr = self._store.get(k)
+            return arr.context if arr is not None else None
+
+        states = getattr(self._updater, "states", None)
+        if states is not None:
+            states.clear()
+            states.update(states_from_host(blob["states"], ctx_for_key))
 
 
 _LOCAL_KINDS = ("local", "local_update_cpu", "local_allreduce_cpu",

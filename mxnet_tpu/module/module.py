@@ -307,14 +307,33 @@ class Module(BaseModule):
             self._kvstore.save_optimizer_states(fname)
         else:
             import pickle
+            from ..optimizer import states_to_host
             with open(fname, "wb") as f:
-                f.write(pickle.dumps(
-                    {k: None for k in (self._updater.states or {})}))
+                f.write(pickle.dumps(states_to_host(self._updater.states)))
 
     def load_optimizer_states(self, fname: str) -> None:
         assert self.optimizer_initialized
         if self._update_on_kvstore:
             self._kvstore.load_optimizer_states(fname)
+        else:
+            import pickle
+            from ..optimizer import states_from_host
+            num_device = len(self._context)
+            param_arrays = self._exec_group.param_arrays
+
+            def ctx_for_key(key):
+                # updater keys are param_index * num_device + device_k
+                # (model._update_params) — states live with their weights
+                i, k = divmod(key, num_device) if isinstance(key, int) \
+                    else (None, None)
+                if i is not None and i < len(param_arrays):
+                    return param_arrays[i][k].context
+                return None
+
+            with open(fname, "rb") as f:
+                blob = pickle.loads(f.read())
+            self._updater.states.clear()
+            self._updater.states.update(states_from_host(blob, ctx_for_key))
 
     def install_monitor(self, mon):
         assert self.binded

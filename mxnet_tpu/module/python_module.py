@@ -67,10 +67,8 @@ class PythonModule(BaseModule):
         pass
 
     def update_metric(self, eval_metric, labels):
-        if self._label_shapes is None:
-            pass
-        else:
-            raise NotImplementedError()
+        if self._label_shapes is not None:
+            eval_metric.update(labels, self.get_outputs())
 
     def bind(self, data_shapes, label_shapes=None, for_training=True,
              inputs_need_grad=False, force_rebind=False, shared_module=None,

@@ -1105,11 +1105,28 @@ def _upsample_fwd(ctx, params, *inputs):
             y = jnp.repeat(jnp.repeat(x, rep_h, axis=2), rep_w, axis=3)
             outs.append(y)
         return jnp.concatenate(outs, axis=1) if len(outs) > 1 else outs[0]
-    # bilinear: reference implements as Deconvolution with a learned/fixed
-    # kernel (weight input); here resize handles the single-input case
+    # bilinear: depthwise transposed conv with the bound (learnable,
+    # bilinear-initialized) weight, as the reference's Deconvolution
+    # (upsampling-inl.h: kernel = 2*scale - scale%2, stride = scale,
+    # pad = ceil((scale-1)/2), num_group = C, weight (C, 1, k, k))
     x = inputs[0]
     n, c, h, w = x.shape
-    return jax.image.resize(x, (n, c, h * scale, w * scale), method="bilinear")
+    if len(inputs) < 2:
+        # weightless fallback (no weight bound): plain bilinear resize
+        return jax.image.resize(x, (n, c, h * scale, w * scale),
+                                method="bilinear")
+    weight = inputs[1]
+    k = 2 * scale - scale % 2
+    p = -(-(scale - 1) // 2)  # ceil((scale-1)/2)
+    wk = jnp.flip(weight, axis=(-2, -1))
+    return jax.lax.conv_general_dilated(
+        x, wk,
+        window_strides=(1, 1),
+        padding=[(k - 1 - p, k - 1 - p), (k - 1 - p, k - 1 - p)],
+        lhs_dilation=(scale, scale),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=c,
+    )
 
 
 def _upsample_args(p):
@@ -1128,9 +1145,14 @@ def _upsample_shape(params, in_shapes):
             return in_shapes, [None], []
         c = sum(s[1] for s in in_shapes)
         out = (d[0], c, d[2] * scale, d[3] * scale)
-    else:
-        out = (d[0], d[1], d[2] * scale, d[3] * scale)
-    return [tuple(s) if s else s for s in in_shapes], [out], []
+        return [tuple(s) if s else s for s in in_shapes], [out], []
+    out = (d[0], d[1], d[2] * scale, d[3] * scale)
+    shapes = [tuple(d)]
+    if len(in_shapes) > 1:
+        # depthwise deconv weight (upsampling-inl.h: Shape4(C, 1, k, k))
+        k = 2 * scale - scale % 2
+        shapes.append((d[1], 1, k, k))
+    return shapes, [out], []
 
 
 register_op(OpDef(

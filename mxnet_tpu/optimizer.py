@@ -342,6 +342,42 @@ def create(name: str, rescale_grad: float = 1.0, **kwargs) -> Optimizer:
     return klass(rescale_grad=rescale_grad, **kwargs)
 
 
+def states_to_host(states: Dict[Any, Any]) -> Dict[Any, Any]:
+    """Serialize an updater's per-index states to host (numpy) form."""
+    from .ndarray import NDArray
+
+    def conv(v):
+        if isinstance(v, NDArray):
+            return ("__nd__", v.asnumpy())
+        if isinstance(v, (list, tuple)):
+            return type(v)(conv(x) for x in v)
+        return v
+
+    return {k: conv(v) for k, v in states.items()}
+
+
+def states_from_host(blob: Dict[Any, Any], ctx_for_key=None) -> Dict[Any, Any]:
+    """Rebuild updater states from :func:`states_to_host` output.
+
+    ``ctx_for_key(key)`` may return the Context to place that key's arrays
+    on (states live with their weights — ``create_state`` allocates on
+    ``weight.context``); None falls back to the default context."""
+    from .ndarray import array as nd_array
+
+    def conv(v, ctx):
+        if isinstance(v, tuple) and len(v) == 2 and v[0] == "__nd__":
+            return nd_array(v[1], ctx=ctx)
+        if isinstance(v, (list, tuple)):
+            return type(v)(conv(x, ctx) for x in v)
+        return v
+
+    out = {}
+    for k, v in blob.items():
+        ctx = ctx_for_key(k) if ctx_for_key is not None else None
+        out[k] = conv(v, ctx)
+    return out
+
+
 def get_updater(optimizer: Optimizer):
     """Closure over per-index states (reference ``optimizer.py:get_updater``);
     used by both local training loops and the KVStore server side."""

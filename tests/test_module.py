@@ -171,3 +171,37 @@ def test_python_loss_module():
     # check the linear layer learned to separate
     out = seq.get_outputs()[0].asnumpy()
     assert out.shape[1] == 2
+
+
+def test_module_optimizer_states_roundtrip(tmp_path):
+    # momentum state must survive save/load (not be pickled away as None)
+    X, y = make_blobs()
+    train = NDArrayIter(X, y, batch_size=25)
+    mod = mx.mod.Module(mlp_symbol(), context=mx.cpu())
+    mod.bind(data_shapes=train.provide_data, label_shapes=train.provide_label)
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
+    for batch in train:
+        mod.forward_backward(batch)
+        mod.update()
+    fname = str(tmp_path / "opt.states")
+    mod.save_optimizer_states(fname)
+    states_before = {
+        k: (v.asnumpy() if hasattr(v, "asnumpy") else v)
+        for k, v in mod._updater.states.items()}
+    assert states_before, "updater should have per-index momentum state"
+
+    mod2 = mx.mod.Module(mlp_symbol(), context=mx.cpu())
+    mod2.bind(data_shapes=train.provide_data, label_shapes=train.provide_label)
+    mod2.init_params()
+    mod2.init_optimizer(optimizer="sgd",
+                        optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
+    mod2.load_optimizer_states(fname)
+    for k, v in states_before.items():
+        v2 = mod2._updater.states[k]
+        v2 = v2.asnumpy() if hasattr(v2, "asnumpy") else v2
+        if v is None:
+            assert v2 is None
+        else:
+            np.testing.assert_allclose(v2, v)

@@ -230,3 +230,40 @@ def test_dropout_deterministic_per_forward():
     assert not np.allclose(a_np, b_np)  # fresh mask each forward
     inf = ex.forward(is_train=False)[0].asnumpy()
     np.testing.assert_allclose(inf, 1.0)
+
+
+def test_shared_exec_different_symbol():
+    # regression: a shared-cache bind over a DIFFERENT symbol must compile
+    # its own program, not reuse the first executor's graph
+    x = sym.Variable("x")
+    sq = x * x
+    cub = x * x * x
+    a = nd.array(np.array([2.0, 3.0], dtype=np.float32))
+    e1 = sq.bind(mx.cpu(), {"x": a})
+    np.testing.assert_allclose(e1.forward()[0].asnumpy(), [4.0, 9.0])
+    e2 = cub.bind(mx.cpu(), {"x": a}, shared_exec=e1)
+    np.testing.assert_allclose(e2.forward()[0].asnumpy(), [8.0, 27.0])
+    # and the first executor still runs its own graph
+    np.testing.assert_allclose(e1.forward()[0].asnumpy(), [4.0, 9.0])
+
+
+def test_upsampling_bilinear_uses_weight():
+    import jax
+    data = sym.Variable("data")
+    w = sym.Variable("w")
+    up = sym.UpSampling(data, w, scale=2, sample_type="bilinear",
+                        num_filter=3, num_args=2)
+    d = nd.array(np.random.rand(1, 3, 4, 4).astype(np.float32))
+    init = mx.initializer.Initializer()
+    warr = nd.zeros((3, 1, 4, 4))
+    init("upsampling_w", warr)  # bilinear kernel
+    gw = nd.zeros(warr.shape)
+    gd = nd.zeros(d.shape)
+    exe = up.bind(mx.cpu(), {"data": d, "w": warr},
+                  args_grad={"data": gd, "w": gw})
+    out = exe.forward(is_train=True)[0].asnumpy()
+    assert out.shape == (1, 3, 8, 8)
+    # interior values should interpolate, and the weight must receive a
+    # nonzero gradient (it is a real learnable deconv kernel)
+    exe.backward()
+    assert np.abs(gw.asnumpy()).sum() > 0

@@ -169,11 +169,22 @@ def test_kvstore_push_pull_aggregation():
     kv = mx.kvstore.create("local")
     shape = (4, 4)
     kv.init(3, nd.ones(shape))
-    # push from 4 "devices" and pull: default updater adds into stored value
+    # push from 4 "devices" without an updater: the merged value lands in a
+    # merge buffer and pull returns it (reference kvstore_local.h Pull —
+    # merged, NOT store + merged)
     kv.push(3, [nd.ones(shape)] * 4)
     out = nd.zeros(shape)
     kv.pull(3, out=out)
-    np.testing.assert_allclose(out.asnumpy(), 5.0)
+    np.testing.assert_allclose(out.asnumpy(), 4.0)
+    # a second identical push must not accumulate across steps
+    kv.push(3, [nd.ones(shape)] * 4)
+    kv.pull(3, out=out)
+    np.testing.assert_allclose(out.asnumpy(), 4.0)
+    # before any push, pull returns the inited weights
+    kv2 = mx.kvstore.create("local")
+    kv2.init(0, nd.ones(shape))
+    kv2.pull(0, out=out)
+    np.testing.assert_allclose(out.asnumpy(), 1.0)
 
 
 def test_kvstore_updater():
