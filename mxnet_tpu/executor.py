@@ -128,46 +128,9 @@ class Executor:
 
     def _eval(self, arg_vals: Dict[str, jax.Array], aux_vals: Dict[str, jax.Array],
               rng, is_train: bool, want_internals: bool = False):
-        vals: Dict[tuple, jax.Array] = {}
-        aux_updates: Dict[str, jax.Array] = {}
-        internals: Dict[str, jax.Array] = {}
-        for idx, node in enumerate(self._topo):
-            if node.is_variable:
-                vals[(id(node), 0)] = arg_vals[node.name]
-                if want_internals:
-                    internals[node.name] = arg_vals[node.name]
-                continue
-            op = node.op
-            params = node.parsed_params()
-            in_vals = [vals[(id(s), i)] for (s, i) in node.inputs]
-            aux_full = node.aux_full_names()
-            short = op.list_aux_states(params)
-            aux = {sh: aux_vals[f] for sh, f in zip(short, aux_full)}
-            node_rng = jax.random.fold_in(rng, idx) if rng is not None else None
-            opctx = OpContext(is_train=is_train, rng=node_rng, aux=aux,
-                              name=node.name)
-            fwd = op.forward
-            anno = node.anno_attrs()
-            if anno.get("force_mirroring") in ("True", "true", "1") and not aux_full:
-                fwd = jax.checkpoint(
-                    lambda *xs, _f=op.forward, _c=opctx, _p=params: _f(_c, _p, *xs))
-                out = fwd(*in_vals)
-            else:
-                out = fwd(opctx, params, *in_vals)
-            outs = list(out) if isinstance(out, (tuple, list)) else [out]
-            for i, o in enumerate(outs):
-                vals[(id(node), i)] = o
-            for sh, f in zip(short, aux_full):
-                if sh in opctx.aux_updates:
-                    aux_updates[f] = opctx.aux_updates[sh]
-            if want_internals:
-                out_names = op.list_outputs(params)
-                for i, o in enumerate(outs):
-                    internals[f"{node.name}_{out_names[i]}"] = o
-        heads = tuple(vals[(id(n), i)] for (n, i) in self._symbol._heads)
-        if want_internals:
-            return heads, aux_updates, internals
-        return heads, aux_updates
+        from .graph_eval import eval_symbol
+        return eval_symbol(self._symbol, arg_vals, aux_vals, rng, is_train,
+                           want_internals=want_internals, topo=self._topo)
 
     # compiled program builders ----------------------------------------
 

@@ -1,0 +1,93 @@
+"""Pure-functional symbol-graph evaluation.
+
+The single tracing core shared by :class:`mxnet_tpu.executor.Executor`
+(single-device bind) and :mod:`mxnet_tpu.parallel` (mesh-sharded compiled
+train steps).  In the reference the graph is walked twice — once by
+``GraphExecutor::InitGraph`` to plan memory and once per batch by ``RunOps``
+(``src/symbol/graph_executor.cc:303,833``); here the walk happens once under
+``jax.jit`` tracing and XLA owns scheduling and buffers.
+
+``eval_symbol`` is pure in (arg values, aux values, rng) -> (head values,
+aux updates) so it can sit inside ``jax.vjp``/``jax.jit``/``shard_map``
+transforms without modification.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+
+from .ops.registry import OpContext
+
+__all__ = ["eval_symbol"]
+
+
+def eval_symbol(symbol, arg_vals: Dict[str, jax.Array],
+                aux_vals: Dict[str, jax.Array], rng, is_train: bool,
+                want_internals: bool = False, topo=None):
+    """Evaluate a Symbol graph on jax values.
+
+    Parameters
+    ----------
+    symbol : Symbol
+        Graph to evaluate; outputs are its head entries.
+    arg_vals : dict name -> jax.Array
+        Values for every variable node (params + data + labels).
+    aux_vals : dict full_name -> jax.Array
+        Auxiliary state values keyed ``{node_name}_{aux_name}``.
+    rng : jax PRNG key or None
+        Folded per-node for dropout/sampling ops.
+    is_train : bool
+        Train-mode flag passed to each op (dropout on, BatchNorm batch
+        stats + moving-average updates).
+    want_internals : bool
+        Also return every node output keyed ``{node_name}_{output_name}``
+        (the monitor-hook path, reference ``graph_executor.cc:890-905``).
+    topo : list of nodes, optional
+        Pre-computed ``symbol._topo()`` to skip re-sorting in hot paths.
+
+    Returns ``(heads, aux_updates)`` or ``(heads, aux_updates, internals)``.
+    """
+    if topo is None:
+        topo = symbol._topo()
+    vals: Dict[tuple, jax.Array] = {}
+    aux_updates: Dict[str, jax.Array] = {}
+    internals: Dict[str, jax.Array] = {}
+    for idx, node in enumerate(topo):
+        if node.is_variable:
+            vals[(id(node), 0)] = arg_vals[node.name]
+            if want_internals:
+                internals[node.name] = arg_vals[node.name]
+            continue
+        op = node.op
+        params = node.parsed_params()
+        in_vals = [vals[(id(s), i)] for (s, i) in node.inputs]
+        aux_full = node.aux_full_names()
+        short = op.list_aux_states(params)
+        aux = {sh: aux_vals[f] for sh, f in zip(short, aux_full)}
+        node_rng = jax.random.fold_in(rng, idx) if rng is not None else None
+        opctx = OpContext(is_train=is_train, rng=node_rng, aux=aux,
+                          name=node.name)
+        anno = node.anno_attrs()
+        if anno.get("force_mirroring") in ("True", "true", "1") and not aux_full:
+            # recompute-in-backward (reference gradient mirroring,
+            # static_graph.cc:404-437) == jax.checkpoint around the node
+            fwd = jax.checkpoint(
+                lambda *xs, _f=op.forward, _c=opctx, _p=params: _f(_c, _p, *xs))
+            out = fwd(*in_vals)
+        else:
+            out = op.forward(opctx, params, *in_vals)
+        outs = list(out) if isinstance(out, (tuple, list)) else [out]
+        for i, o in enumerate(outs):
+            vals[(id(node), i)] = o
+        for sh, f in zip(short, aux_full):
+            if sh in opctx.aux_updates:
+                aux_updates[f] = opctx.aux_updates[sh]
+        if want_internals:
+            out_names = op.list_outputs(params)
+            for i, o in enumerate(outs):
+                internals[f"{node.name}_{out_names[i]}"] = o
+    heads = tuple(vals[(id(n), i)] for (n, i) in symbol._heads)
+    if want_internals:
+        return heads, aux_updates, internals
+    return heads, aux_updates

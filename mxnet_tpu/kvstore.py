@@ -89,14 +89,19 @@ class KVStore:
         for k, vgroup in zip(keys, values):
             if k not in self._store:
                 raise MXNetError(f"kvstore: key {k} not initialized")
-            # gather to the store's device then reduce — the analog of the
-            # GPU→pinned-CPU copies + ReduceSumCPU (kvstore_local.h:148-236)
+            if len(vgroup) > 1:
+                # device-resident all-reduce over ICI (shard_map psum) —
+                # replaces the reference's GPU→pinned-CPU copies +
+                # ReduceSumCPU funnel (kvstore_local.h:148-236); falls back
+                # to an on-device tree sum when shards are co-resident
+                from .parallel.collectives import allreduce_sum
+                reduced = allreduce_sum([v.data for v in vgroup])
+                merged_val = reduced[0]
+            else:
+                merged_val = vgroup[0].data
             dev = self._store[k].context.jax_device
-            parts = [jax.device_put(v.data, dev) for v in vgroup]
-            merged = parts[0]
-            for p in parts[1:]:
-                merged = merged + p
-            merged_nd = NDArray(merged, ctx=self._store[k].context)
+            merged_nd = NDArray(jax.device_put(merged_val, dev),
+                                ctx=self._store[k].context)
             if self._updater is not None:
                 self._updater(k, merged_nd, self._store[k])
             else:
@@ -171,13 +176,28 @@ _LOCAL_KINDS = ("local", "local_update_cpu", "local_allreduce_cpu",
 
 
 def create(name: str = "local") -> KVStore:
-    """Create a store by type (reference ``kvstore.cc:17-48``)."""
+    """Create a store by type (reference ``kvstore.cc:17-48``).
+
+    For ``dist*`` kinds, non-worker processes never return: a process
+    launched with role ``server``/``scheduler`` runs its blocking loop and
+    exits — the reference behavior of ``kvstore_server.
+    _init_kvstore_server_module`` (``python/mxnet/kvstore_server.py:58``).
+    """
     if not isinstance(name, str):
         raise MXNetError("name must be a string")
     if name in _LOCAL_KINDS:
         return KVStore(name)
     if name.startswith("dist"):
-        from .parallel.dist_kvstore import DistKVStore
-        return DistKVStore(name)
+        import sys
+        from .parallel import dist_kvstore as dkv
+        cfg = dkv.role_from_env()
+        role = cfg.get("role", "worker")
+        if role == "scheduler":
+            dkv.run_scheduler(cfg)
+            sys.exit(0)
+        if role == "server":
+            dkv.run_server(cfg)
+            sys.exit(0)
+        return dkv.DistKVStore(name)
     raise MXNetError(f"unknown kvstore type {name}; known: "
                      f"{_LOCAL_KINDS + ('dist', 'dist_sync', 'dist_async')}")

@@ -6,21 +6,24 @@ AdaDelta:727) and the C++ server-side optimizer (``src/optimizer/sgd-inl.h``
 — here every optimizer runs as XLA ops so there is no separate "cc" tier;
 ``ccSGD`` is an alias with the reference's flat-momentum semantics).
 
-``update(index, weight, grad, state)`` mutates the bound weight NDArray —
-on TPU this is a fused XLA update; the Module/parallel layers instead use
-the functional form :meth:`Optimizer.apply` inside one jitted train step.
+Every optimizer has a pure functional core ``_functional_step(hyper, w, g,
+state, lr, wd, t, rng) -> (new_w, new_state)`` that is traceable under
+``jax.jit``/``shard_map``.  The imperative ``update(index, weight, grad,
+state)`` API wraps that core in one cached jitted call per (class, shape)
+— no un-jitted per-parameter host arithmetic in the training hot loop —
+and :mod:`mxnet_tpu.parallel` inlines the same core INSIDE its compiled
+mesh-sharded train step so weight updates fuse with the backward pass.
 """
 from __future__ import annotations
 
-import math
 from typing import Any, Dict, Optional, Tuple
 
+import jax
 import jax.numpy as jnp
-import numpy as np
 
 from .base import MXNetError, Registry
 from .lr_scheduler import LRScheduler
-from .ndarray import NDArray, zeros
+from .ndarray import NDArray
 
 __all__ = ["Optimizer", "SGD", "NAG", "SGLD", "ccSGD", "Adam", "AdaGrad",
            "RMSProp", "AdaDelta", "Test", "create", "get_updater", "register"]
@@ -34,8 +37,42 @@ def register(klass):
     return klass
 
 
+def _prep_grad(g, hyper):
+    """rescale + clip, shared by all functional steps (reference
+    ``optimizer.py`` rescale_grad/clip_gradient handling)."""
+    g = g * hyper["rescale_grad"]
+    if "clip_gradient" in hyper:
+        g = jnp.clip(g, -hyper["clip_gradient"], hyper["clip_gradient"])
+    return g
+
+
+def _state_data(state):
+    """NDArray state pytree -> jax value pytree."""
+    if state is None:
+        return None
+    if isinstance(state, NDArray):
+        return state.data
+    if isinstance(state, (list, tuple)):
+        return type(state)(_state_data(s) for s in state)
+    return state
+
+
+def _state_writeback(state, new_vals):
+    if state is None:
+        return
+    if isinstance(state, NDArray):
+        state._write(new_vals)
+        return
+    if isinstance(state, (list, tuple)):
+        for s, v in zip(state, new_vals):
+            _state_writeback(s, v)
+
+
 class Optimizer:
     """Base optimizer (reference ``optimizer.py:25``)."""
+
+    _needs_rng = False
+    _JIT_STEPS: Dict[Any, Any] = {}
 
     def __init__(self, rescale_grad: float = 1.0, param_idx2name: Optional[Dict[int, str]] = None,
                  wd: float = 0.0, clip_gradient: Optional[float] = None,
@@ -118,19 +155,64 @@ class Optimizer:
                 wd = 0.0
         return wd
 
+    # --- functional core ----------------------------------------------
+
+    def _hyper(self) -> Dict[str, float]:
+        """Scalar hyperparameters fed to :meth:`_functional_step` as traced
+        values (so lr schedules / hyper changes never recompile)."""
+        h = {"rescale_grad": self.rescale_grad}
+        if self.clip_gradient is not None:
+            h["clip_gradient"] = self.clip_gradient
+        return h
+
+    def state_zeros_like(self, weight_val):
+        """Pure state init mirroring :meth:`create_state`, on jax values —
+        used by compiled trainers that keep optimizer state as sharded
+        pytrees rather than NDArrays."""
+        return None
+
+    @staticmethod
+    def _functional_step(hyper, w, g, state, lr, wd, t, rng):
+        raise NotImplementedError
+
+    @classmethod
+    def _jitted_step(cls):
+        fn = Optimizer._JIT_STEPS.get(cls)
+        if fn is None:
+            fn = jax.jit(cls._functional_step)
+            Optimizer._JIT_STEPS[cls] = fn
+        return fn
+
     # --- state + update ------------------------------------------------
 
     def create_state(self, index, weight: NDArray):
-        return None
+        sval = self.state_zeros_like(weight.data)
+
+        def conv(v):
+            if isinstance(v, (list, tuple)):
+                return type(v)(conv(x) for x in v)
+            if v is None:
+                return None
+            return NDArray(jax.device_put(v, weight.context.jax_device),
+                           ctx=weight.context)
+
+        return conv(sval)
 
     def update(self, index, weight: NDArray, grad: NDArray, state) -> None:
-        raise NotImplementedError
-
-    def _preprocess_grad(self, grad_val):
-        g = grad_val * self.rescale_grad
-        if self.clip_gradient is not None:
-            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
-        return g
+        """One fused XLA dispatch: rescale/clip + state + weight update."""
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        t = self._index_update_count[index]
+        rng = None
+        if self._needs_rng:
+            from . import random as _random
+            rng = _random._next_key()
+        new_w, new_s = self._jitted_step()(
+            self._hyper(), weight.data, grad.data, _state_data(state),
+            lr, wd, t, rng)
+        weight._write(new_w)
+        _state_writeback(state, new_s)
 
 
 @register
@@ -141,60 +223,50 @@ class SGD(Optimizer):
         super().__init__(**kwargs)
         self.momentum = momentum
 
-    def create_state(self, index, weight):
+    def _hyper(self):
+        h = super()._hyper()
+        h["momentum"] = self.momentum
+        return h
+
+    def state_zeros_like(self, weight_val):
         if self.momentum == 0.0:
             return None
-        return zeros(weight.shape, weight.context, dtype=weight.dtype)
+        return jnp.zeros_like(weight_val)
 
-    def update(self, index, weight, grad, state):
-        lr = self._get_lr(index)
-        wd = self._get_wd(index)
-        self._update_count(index)
-        g = self._preprocess_grad(grad.data)
-        w = weight.data
+    @staticmethod
+    def _functional_step(hyper, w, g, state, lr, wd, t, rng):
+        g = _prep_grad(g, hyper)
         if state is not None:
-            mom = self.momentum * state.data - lr * (g + wd * w)
-            state._write(mom)
-            weight._write(w + mom)
-        else:
-            weight._write(w - lr * (g + wd * w))
+            mom = hyper["momentum"] * state - lr * (g + wd * w)
+            return w + mom, mom
+        return w - lr * (g + wd * w), None
 
 
 @register
 class NAG(SGD):
     """Nesterov accelerated SGD (reference ``optimizer.py:312``)."""
 
-    def update(self, index, weight, grad, state):
-        lr = self._get_lr(index)
-        wd = self._get_wd(index)
-        self._update_count(index)
-        g = self._preprocess_grad(grad.data)
-        w = weight.data
+    @staticmethod
+    def _functional_step(hyper, w, g, state, lr, wd, t, rng):
+        g = _prep_grad(g, hyper)
         if state is not None:
-            mom = self.momentum * state.data
             gw = g + wd * w
-            mom = mom - lr * gw
-            state._write(mom)
-            weight._write(w + self.momentum * mom - lr * gw)
-        else:
-            weight._write(w - lr * (g + wd * w))
+            mom = hyper["momentum"] * state - lr * gw
+            return w + hyper["momentum"] * mom - lr * gw, mom
+        return w - lr * (g + wd * w), None
 
 
 @register
 class SGLD(Optimizer):
     """Stochastic gradient Langevin dynamics (reference ``optimizer.py:360``)."""
 
-    def update(self, index, weight, grad, state):
-        lr = self._get_lr(index)
-        wd = self._get_wd(index)
-        self._update_count(index)
-        g = self._preprocess_grad(grad.data)
-        w = weight.data
-        from . import random as _random
-        import jax
-        noise = jax.random.normal(_random._next_key(), w.shape,
-                                  dtype=w.dtype) * math.sqrt(lr)
-        weight._write(w - lr / 2 * (g + wd * w) + noise)
+    _needs_rng = True
+
+    @staticmethod
+    def _functional_step(hyper, w, g, state, lr, wd, t, rng):
+        g = _prep_grad(g, hyper)
+        noise = jax.random.normal(rng, w.shape, dtype=w.dtype) * jnp.sqrt(lr)
+        return w - lr / 2 * (g + wd * w) + noise, None
 
 
 @register
@@ -215,29 +287,27 @@ class Adam(Optimizer):
         self.beta2 = beta2
         self.epsilon = epsilon
         self.decay_factor = decay_factor
-        self.time = 0
-        self.time_first_index: Optional[int] = None
 
-    def create_state(self, index, weight):
-        self.time_first_index = None
-        return (zeros(weight.shape, weight.context, dtype=weight.dtype),
-                zeros(weight.shape, weight.context, dtype=weight.dtype))
+    def _hyper(self):
+        h = super()._hyper()
+        h.update(beta1=self.beta1, beta2=self.beta2, epsilon=self.epsilon)
+        return h
 
-    def update(self, index, weight, grad, state):
-        lr = self._get_lr(index)
-        self._update_count(index)
-        t = self._index_update_count[index]
+    def state_zeros_like(self, weight_val):
+        return (jnp.zeros_like(weight_val), jnp.zeros_like(weight_val))
+
+    @staticmethod
+    def _functional_step(hyper, w, g, state, lr, wd, t, rng):
         mean, variance = state
-        wd = self._get_wd(index)
-        g = self._preprocess_grad(grad.data) + wd * weight.data
-        m = self.beta1 * mean.data + (1.0 - self.beta1) * g
-        v = self.beta2 * variance.data + (1.0 - self.beta2) * g * g
-        mean._write(m)
-        variance._write(v)
-        coef1 = 1.0 - self.beta1 ** t
-        coef2 = 1.0 - self.beta2 ** t
-        lr_t = lr * math.sqrt(coef2) / coef1
-        weight._write(weight.data - lr_t * m / (jnp.sqrt(v) + self.epsilon))
+        b1, b2 = hyper["beta1"], hyper["beta2"]
+        g = _prep_grad(g, hyper) + wd * w
+        m = b1 * mean + (1.0 - b1) * g
+        v = b2 * variance + (1.0 - b2) * g * g
+        t = jnp.asarray(t, dtype=w.dtype)
+        coef1 = 1.0 - b1 ** t
+        coef2 = 1.0 - b2 ** t
+        lr_t = lr * jnp.sqrt(coef2) / coef1
+        return w - lr_t * m / (jnp.sqrt(v) + hyper["epsilon"]), (m, v)
 
 
 @register
@@ -248,18 +318,19 @@ class AdaGrad(Optimizer):
         super().__init__(**kwargs)
         self.float_stable_eps = eps
 
-    def create_state(self, index, weight):
-        return zeros(weight.shape, weight.context, dtype=weight.dtype)
+    def _hyper(self):
+        h = super()._hyper()
+        h["eps"] = self.float_stable_eps
+        return h
 
-    def update(self, index, weight, grad, state):
-        lr = self._get_lr(index)
-        wd = self._get_wd(index)
-        self._update_count(index)
-        g = self._preprocess_grad(grad.data)
-        history = state.data + g * g
-        state._write(history)
-        weight._write(weight.data - lr * (
-            g / jnp.sqrt(history + self.float_stable_eps) + wd * weight.data))
+    def state_zeros_like(self, weight_val):
+        return jnp.zeros_like(weight_val)
+
+    @staticmethod
+    def _functional_step(hyper, w, g, state, lr, wd, t, rng):
+        g = _prep_grad(g, hyper)
+        history = state + g * g
+        return w - lr * (g / jnp.sqrt(history + hyper["eps"]) + wd * w), history
 
 
 @register
@@ -273,25 +344,24 @@ class RMSProp(Optimizer):
         self.gamma1 = gamma1
         self.gamma2 = gamma2
 
-    def create_state(self, index, weight):
-        return (zeros(weight.shape, weight.context, dtype=weight.dtype),  # n
-                zeros(weight.shape, weight.context, dtype=weight.dtype),  # g
-                zeros(weight.shape, weight.context, dtype=weight.dtype))  # delta
+    def _hyper(self):
+        h = super()._hyper()
+        h.update(gamma1=self.gamma1, gamma2=self.gamma2)
+        return h
 
-    def update(self, index, weight, grad, state):
-        lr = self._get_lr(index)
-        wd = self._get_wd(index)
-        self._update_count(index)
+    def state_zeros_like(self, weight_val):
+        z = jnp.zeros_like(weight_val)
+        return (z, z, z)  # n, g, delta
+
+    @staticmethod
+    def _functional_step(hyper, w, g_in, state, lr, wd, t, rng):
         n, g_avg, delta = state
-        g = self._preprocess_grad(grad.data) + wd * weight.data
-        n_new = (1 - self.gamma1) * g * g + self.gamma1 * n.data
-        g_new = (1 - self.gamma1) * g + self.gamma1 * g_avg.data
-        n._write(n_new)
-        g_avg._write(g_new)
-        d = self.gamma2 * delta.data - lr * g / jnp.sqrt(
-            n_new - g_new * g_new + 1e-4)
-        delta._write(d)
-        weight._write(weight.data + d)
+        g1, g2 = hyper["gamma1"], hyper["gamma2"]
+        g = _prep_grad(g_in, hyper) + wd * w
+        n_new = (1 - g1) * g * g + g1 * n
+        g_new = (1 - g1) * g + g1 * g_avg
+        d = g2 * delta - lr * g / jnp.sqrt(n_new - g_new * g_new + 1e-4)
+        return w + d, (n_new, g_new, d)
 
 
 @register
@@ -303,34 +373,36 @@ class AdaDelta(Optimizer):
         self.rho = rho
         self.epsilon = epsilon
 
-    def create_state(self, index, weight):
-        return (zeros(weight.shape, weight.context, dtype=weight.dtype),
-                zeros(weight.shape, weight.context, dtype=weight.dtype))
+    def _hyper(self):
+        h = super()._hyper()
+        h.update(rho=self.rho, epsilon=self.epsilon)
+        return h
 
-    def update(self, index, weight, grad, state):
-        wd = self._get_wd(index)
-        self._update_count(index)
-        g = self._preprocess_grad(grad.data)
+    def state_zeros_like(self, weight_val):
+        return (jnp.zeros_like(weight_val), jnp.zeros_like(weight_val))
+
+    @staticmethod
+    def _functional_step(hyper, w, g, state, lr, wd, t, rng):
+        rho, eps = hyper["rho"], hyper["epsilon"]
+        g = _prep_grad(g, hyper)
         acc_g, acc_delta = state
-        ag = self.rho * acc_g.data + (1.0 - self.rho) * g * g
-        acc_g._write(ag)
-        current_delta = (jnp.sqrt(acc_delta.data + self.epsilon) /
-                         jnp.sqrt(ag + self.epsilon)) * g
-        acc_delta._write(self.rho * acc_delta.data +
-                         (1.0 - self.rho) * current_delta * current_delta)
-        weight._write(weight.data - current_delta - wd * weight.data)
+        ag = rho * acc_g + (1.0 - rho) * g * g
+        current_delta = (jnp.sqrt(acc_delta + eps) / jnp.sqrt(ag + eps)) * g
+        ad = rho * acc_delta + (1.0 - rho) * current_delta * current_delta
+        return w - current_delta - wd * w, (ag, ad)
 
 
 @register
 class Test(Optimizer):
     """Test optimizer: w += g (reference ``optimizer.py:781``)."""
 
-    def create_state(self, index, weight):
-        return zeros(weight.shape, weight.context, dtype=weight.dtype)
+    def state_zeros_like(self, weight_val):
+        return jnp.zeros_like(weight_val)
 
-    def update(self, index, weight, grad, state):
-        weight._write(weight.data + grad.data * self.rescale_grad)
-        state._write(weight.data)
+    @staticmethod
+    def _functional_step(hyper, w, g, state, lr, wd, t, rng):
+        new_w = w + g * hyper["rescale_grad"]
+        return new_w, new_w
 
 
 def create(name: str, rescale_grad: float = 1.0, **kwargs) -> Optimizer:

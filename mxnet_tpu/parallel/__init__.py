@@ -1,0 +1,30 @@
+"""mxnet_tpu.parallel: multi-chip / multi-host execution.
+
+The reference's distributed tier is ps-lite + engine-overlapped Python
+slicing (SURVEY §2.4).  The TPU-native stack has three layers:
+
+* :mod:`.mesh` / :mod:`.collectives` — named device meshes and
+  ``shard_map``/``psum`` reductions over ICI (replaces KVStoreLocal's
+  pinned-CPU reduce, ``src/kvstore/kvstore_local.h:135-236``);
+* :mod:`.trainer` — :class:`ShardedTrainer`: forward+backward+all-reduce+
+  update compiled into ONE program over the mesh (replaces
+  ``DataParallelExecutorManager`` + push/pull);
+* :mod:`.dist_kvstore` / :mod:`.launch` / :mod:`.dist` — the multi-process
+  tier: parameter-server semantics parity (``dist_sync``/``dist_async``,
+  ``kvstore_dist_server.h``) over TCP, a local/ssh launcher
+  (``tools/launch.py``), and ``jax.distributed`` rendezvous for the
+  collective pod path.
+"""
+from .mesh import (DATA_AXIS, EXPERT_AXIS, MODEL_AXIS, PIPE_AXIS, SEQ_AXIS,
+                   batch_sharding, current_mesh, data_parallel_mesh,
+                   default_mesh, make_mesh, param_sharding, replicated)
+from .collectives import allreduce_mean, allreduce_sum
+from .trainer import ShardedTrainer, ShardingRules
+
+__all__ = [
+    "DATA_AXIS", "MODEL_AXIS", "SEQ_AXIS", "PIPE_AXIS", "EXPERT_AXIS",
+    "make_mesh", "data_parallel_mesh", "default_mesh", "current_mesh",
+    "batch_sharding", "param_sharding", "replicated",
+    "allreduce_sum", "allreduce_mean",
+    "ShardedTrainer", "ShardingRules",
+]

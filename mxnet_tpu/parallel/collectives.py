@@ -1,0 +1,95 @@
+"""Device-resident collectives over per-device arrays.
+
+The reference reduces multi-device gradients by copying every shard into
+pinned CPU memory and summing with OpenMP (``src/kvstore/
+kvstore_local.h:148-236``) or into GPU merge buffers (``kvstore_device.h:
+37-70``).  The TPU-native replacement: form a global array whose shards ARE
+the per-device values (zero-copy via
+``jax.make_array_from_single_device_arrays``) and run one compiled
+``shard_map``/``psum`` — XLA lowers it to an ICI all-reduce, no host
+round-trips.  This backs the KVStore ``device``/``local`` tiers when the
+pushed values live on distinct devices.
+"""
+from __future__ import annotations
+
+import functools
+from typing import List, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..base import MXNetError
+
+__all__ = ["allreduce_sum", "allreduce_mean", "distinct_devices"]
+
+
+def distinct_devices(arrays: Sequence[jax.Array]) -> bool:
+    """True when each array is committed to its own single device."""
+    seen = set()
+    for a in arrays:
+        devs = getattr(a, "devices", None)
+        if devs is None:
+            return False
+        ds = devs() if callable(devs) else devs
+        if len(ds) != 1:
+            return False
+        d = next(iter(ds))
+        if d in seen:
+            return False
+        seen.add(d)
+    return True
+
+
+@functools.lru_cache(maxsize=None)
+def _allreduce_prog(devices, mean: bool):
+    mesh = Mesh(np.array(devices), ("dev",))
+    n = len(devices)
+
+    def body(x):
+        s = jax.lax.psum(x, "dev")
+        return s / n if mean else s
+
+    return jax.jit(jax.shard_map(body, mesh=mesh, in_specs=P("dev"),
+                                 out_specs=P("dev"))), mesh
+
+
+def _allreduce(arrays: List[jax.Array], mean: bool) -> List[jax.Array]:
+    if len(arrays) == 1:
+        return list(arrays)
+    if not distinct_devices(arrays):
+        # degenerate tier (shards co-resident): plain tree sum on device —
+        # the single-device path the reference also special-cases
+        acc = arrays[0]
+        for a in arrays[1:]:
+            acc = acc + jax.device_put(a, next(iter(arrays[0].devices())))
+        if mean:
+            acc = acc / len(arrays)
+        return [acc] * len(arrays)
+    shape = arrays[0].shape
+    dtype = arrays[0].dtype
+    for a in arrays[1:]:
+        if a.shape != shape or a.dtype != dtype:
+            raise MXNetError("allreduce: mismatched shapes/dtypes")
+    devices = tuple(next(iter(a.devices())) for a in arrays)
+    prog, mesh = _allreduce_prog(devices, mean)
+    shards = [a[None] for a in arrays]  # (1, *shape), stays on its device
+    global_arr = jax.make_array_from_single_device_arrays(
+        (len(arrays),) + tuple(shape), NamedSharding(mesh, P("dev")), shards)
+    out = prog(global_arr)
+    # per-device results, in input order (addressable_shards order matches
+    # the mesh's device order == input order)
+    by_dev = {s.device: s.data for s in out.addressable_shards}
+    return [by_dev[d][0] for d in devices]
+
+
+def allreduce_sum(arrays: List[jax.Array]) -> List[jax.Array]:
+    """Sum N same-shaped arrays living on N devices; each device gets the
+    total.  One XLA all-reduce over ICI."""
+    return _allreduce(list(arrays), mean=False)
+
+
+def allreduce_mean(arrays: List[jax.Array]) -> List[jax.Array]:
+    return _allreduce(list(arrays), mean=True)

@@ -1,0 +1,73 @@
+"""Multi-host collective runtime (``jax.distributed`` tier).
+
+On a real TPU pod the fast path for multi-host data parallelism is NOT the
+parameter server (:mod:`.dist_kvstore`) — it is a global device mesh whose
+``data`` axis spans hosts, with XLA emitting all-reduces over ICI/DCN.  The
+reference has no analog (its only cross-host transport is ps-lite ZMQ,
+``kvstore_dist.h``); SURVEY §7 names this tier explicitly.
+
+Usage on each host of a pod (the ``tools/launch.py`` analog for the
+collective tier)::
+
+    from mxnet_tpu.parallel import dist, make_mesh
+    dist.init_distributed()            # env-driven rendezvous
+    mesh = make_mesh({"data": -1})     # all chips across all hosts
+    trainer = ShardedTrainer(sym, mesh=mesh, ...)
+
+``ShardedTrainer`` then works unchanged: ``jax.devices()`` is global after
+initialization and the batch must be fed per-host via
+``host_local_array_to_global_array``-style placement (each host supplies
+its shard of the global batch).
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from ..base import MXNetError
+
+__all__ = ["init_distributed", "is_initialized", "process_index",
+           "process_count"]
+
+_initialized = False
+
+
+def init_distributed(coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None) -> None:
+    """Initialize ``jax.distributed`` from args or environment.
+
+    Env fallbacks: ``MXTPU_COORDINATOR`` (host:port), ``MXTPU_NUM_PROC``,
+    ``MXTPU_PROC_ID``; on Cloud TPU all three may be omitted and the TPU
+    metadata service provides them.
+    """
+    global _initialized
+    if _initialized:
+        return
+    import jax
+    coordinator_address = coordinator_address or os.environ.get("MXTPU_COORDINATOR")
+    if num_processes is None and "MXTPU_NUM_PROC" in os.environ:
+        num_processes = int(os.environ["MXTPU_NUM_PROC"])
+    if process_id is None and "MXTPU_PROC_ID" in os.environ:
+        process_id = int(os.environ["MXTPU_PROC_ID"])
+    try:
+        jax.distributed.initialize(coordinator_address=coordinator_address,
+                                   num_processes=num_processes,
+                                   process_id=process_id)
+    except Exception as e:  # pragma: no cover - env-specific
+        raise MXNetError(f"jax.distributed initialization failed: {e}") from e
+    _initialized = True
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def process_index() -> int:
+    import jax
+    return jax.process_index()
+
+
+def process_count() -> int:
+    import jax
+    return jax.process_count()

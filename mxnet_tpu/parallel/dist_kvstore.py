@@ -1,0 +1,487 @@
+"""Distributed KVStore: parameter-server tier over TCP.
+
+TPU-native rebuild of the reference's ps-lite distributed stack
+(``src/kvstore/kvstore_dist.h:28-280``, ``kvstore_dist_server.h:85-230``,
+``python/mxnet/kvstore_server.py``):
+
+* roles (scheduler / server / worker) come from environment variables set
+  by :mod:`mxnet_tpu.parallel.launch` — the analog of ``DMLC_ROLE`` etc.
+  (``tools/launch.py:27-70``);
+* **sync mode** buffers pushes per key until every worker has contributed,
+  runs the (pickled, broadcast) optimizer, then releases all pushers —
+  the exact barrier-per-key semantics of ``kvstore_dist_server.h:137-215``;
+* **async mode** applies the updater per push immediately
+  (``kvstore_dist_server.h:194-201``);
+* keys hash across servers, and arrays larger than
+  ``MXNET_KVSTORE_BIGARRAY_BOUND`` are striped over ALL servers
+  (``kvstore_dist.h:231-269``);
+* within a worker, multi-device gradients are first combined on-device via
+  XLA collectives (:mod:`mxnet_tpu.parallel.collectives`) before the
+  host-side push — device reduction rides ICI, only the cross-process hop
+  touches the host.
+
+On real multi-host TPU pods the in-step collective path
+(:func:`mxnet_tpu.parallel.dist.init_distributed` + a global mesh) is the
+fast tier; this PS tier exists for API/semantics parity — including
+``dist_async``'s bounded-staleness behavior, which has no XLA-collective
+analog (SURVEY §5).
+"""
+from __future__ import annotations
+
+import atexit
+import os
+import pickle
+import socket
+import struct
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..base import MXNetError
+from ..kvstore import KVStore, _value_list
+from ..ndarray import NDArray, array as nd_array
+
+__all__ = ["DistKVStore", "run_server", "run_scheduler", "role_from_env",
+           "BIGARRAY_BOUND"]
+
+# reference env: MXNET_KVSTORE_BIGARRAY_BOUND (kvstore_dist.h:243-266)
+BIGARRAY_BOUND = int(os.environ.get("MXNET_KVSTORE_BIGARRAY_BOUND", 1 << 20))
+
+_STOP_SERVER = -1   # kvstore_dist_server.h:22
+_SYNC_MODE = -2     # kvstore_dist_server.h:23
+
+
+# ---------------------------------------------------------------------------
+# Wire protocol: 4-byte length + pickled tuple.  Arrays travel as
+# (dtype str, shape, raw bytes) to avoid pickling numpy object graphs.
+# ---------------------------------------------------------------------------
+
+def _send(sock: socket.socket, msg: Any) -> None:
+    blob = pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(struct.pack("!I", len(blob)) + blob)
+
+
+def _recv(sock: socket.socket) -> Any:
+    hdr = _recv_exact(sock, 4)
+    (n,) = struct.unpack("!I", hdr)
+    return pickle.loads(_recv_exact(sock, n))
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise MXNetError("kvstore connection closed")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _pack_arr(a: np.ndarray) -> Tuple[str, tuple, bytes]:
+    a = np.ascontiguousarray(a)
+    return (str(a.dtype), a.shape, a.tobytes())
+
+
+def _unpack_arr(t: Tuple[str, tuple, bytes]) -> np.ndarray:
+    dtype, shape, raw = t
+    return np.frombuffer(raw, dtype=dtype).reshape(shape).copy()
+
+
+def role_from_env() -> Dict[str, Any]:
+    """Cluster config from env (launcher-provided; DMLC_* names accepted
+    for reference-launcher compatibility)."""
+    def get(name, dmlc, default=None):
+        return os.environ.get(name, os.environ.get(dmlc, default))
+    role = get("MXTPU_ROLE", "DMLC_ROLE")
+    if role is None:
+        return {}
+    return {
+        "role": role,
+        "root_host": get("MXTPU_PS_ROOT_URI", "DMLC_PS_ROOT_URI", "127.0.0.1"),
+        "root_port": int(get("MXTPU_PS_ROOT_PORT", "DMLC_PS_ROOT_PORT", "9091")),
+        "num_workers": int(get("MXTPU_NUM_WORKER", "DMLC_NUM_WORKER", "1")),
+        "num_servers": int(get("MXTPU_NUM_SERVER", "DMLC_NUM_SERVER", "1")),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Scheduler: rendezvous + worker barrier (the ps-lite Postoffice analog)
+# ---------------------------------------------------------------------------
+
+def run_scheduler(cfg: Optional[Dict[str, Any]] = None) -> None:
+    """Blocking scheduler loop.  Servers register their listen addresses;
+    workers register and receive (rank, server table); ``barrier`` releases
+    when every worker arrives (``kvstore.h:232`` Barrier semantics)."""
+    cfg = cfg or role_from_env()
+    lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    lsock.bind((cfg["root_host"], cfg["root_port"]))
+    lsock.listen(64)
+
+    lock = threading.Condition()
+    servers: List[Tuple[str, int]] = []
+    worker_socks: List[socket.socket] = []
+    barrier_waiting: List[socket.socket] = []
+    state = {"stops": 0, "done": False}
+
+    def handle(conn: socket.socket):
+        try:
+            while True:
+                msg = _recv(conn)
+                kind = msg[0]
+                if kind == "register_server":
+                    with lock:
+                        servers.append(tuple(msg[1]))
+                        sid = len(servers) - 1
+                        lock.notify_all()
+                    _send(conn, ("ok", sid))
+                elif kind == "register_worker":
+                    with lock:
+                        while len(servers) < cfg["num_servers"]:
+                            lock.wait()
+                        worker_socks.append(conn)
+                        rank = len(worker_socks) - 1
+                    _send(conn, ("ok", rank, list(servers)))
+                elif kind == "barrier":
+                    with lock:
+                        barrier_waiting.append(conn)
+                        if len(barrier_waiting) == cfg["num_workers"]:
+                            for c in barrier_waiting:
+                                _send(c, ("barrier_done",))
+                            barrier_waiting.clear()
+                elif kind == "stop":
+                    with lock:
+                        state["stops"] += 1
+                        if state["stops"] >= cfg["num_workers"]:
+                            state["done"] = True
+                            lock.notify_all()
+                    return
+        except (MXNetError, OSError):
+            return
+
+    def acceptor():
+        while True:
+            try:
+                conn, _ = lsock.accept()
+            except OSError:
+                return
+            threading.Thread(target=handle, args=(conn,), daemon=True).start()
+
+    threading.Thread(target=acceptor, daemon=True).start()
+    with lock:
+        while not state["done"]:
+            lock.wait()
+    lsock.close()
+
+
+# ---------------------------------------------------------------------------
+# Server: per-key aggregation + updater (KVStoreDistServer analog)
+# ---------------------------------------------------------------------------
+
+class _ServerState:
+    def __init__(self, num_workers: int):
+        self.num_workers = num_workers
+        self.sync_mode = False
+        self.store: Dict[Any, NDArray] = {}
+        self.merge: Dict[Any, np.ndarray] = {}
+        self.push_count: Dict[Any, int] = {}
+        self.round_no: Dict[Any, int] = {}
+        self.updater = None
+        self.lock = threading.Condition()
+
+    def set_optimizer_blob(self, blob: bytes) -> None:
+        from ..optimizer import get_updater
+        optimizer = pickle.loads(blob)
+        with self.lock:
+            self.updater = get_updater(optimizer)
+
+    def init_key(self, key, arr: np.ndarray) -> None:
+        with self.lock:
+            self.store[key] = nd_array(arr)
+            self.round_no.setdefault(key, 0)
+
+    def _apply(self, key) -> None:
+        """Aggregation complete for this round: update stored weights
+        (kvstore_dist_server.h:164-192)."""
+        merged = nd_array(self.merge.pop(key))
+        if self.updater is not None:
+            self.updater(key, merged, self.store[key])
+        else:
+            self.store[key] = merged
+        self.push_count[key] = 0
+        self.round_no[key] += 1
+
+    def push(self, key, arr: np.ndarray) -> None:
+        with self.lock:
+            if key not in self.store:
+                raise MXNetError(f"dist server: push to uninitialized key "
+                                 f"{key!r} (call kv.init first)")
+            if not self.sync_mode:
+                grad = nd_array(arr)
+                if self.updater is not None:
+                    self.updater(key, grad, self.store[key])
+                else:
+                    self.store[key] = grad
+                return
+            if key in self.merge:
+                self.merge[key] = self.merge[key] + arr
+            else:
+                self.merge[key] = arr.copy()
+            self.push_count[key] = self.push_count.get(key, 0) + 1
+            my_round = self.round_no.setdefault(key, 0)
+            if self.push_count[key] == self.num_workers:
+                self._apply(key)
+                self.lock.notify_all()
+            else:
+                while self.round_no[key] == my_round:
+                    self.lock.wait()
+
+    def pull(self, key) -> np.ndarray:
+        with self.lock:
+            if key not in self.store:
+                raise MXNetError(f"dist server: key {key!r} not initialized")
+            return self.store[key].asnumpy()
+
+
+def run_server(cfg: Optional[Dict[str, Any]] = None) -> None:
+    """Blocking server loop (reference ``KVStoreDistServer::Run``)."""
+    cfg = cfg or role_from_env()
+    state = _ServerState(cfg["num_workers"])
+
+    local = cfg["root_host"] in ("127.0.0.1", "localhost")
+    lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    lsock.bind((cfg["root_host"] if local else "0.0.0.0", 0))
+    port = lsock.getsockname()[1]
+    lsock.listen(64)
+
+    # register with the scheduler, advertising THIS host's address (on
+    # multi-host runs the server is not on the scheduler's machine)
+    ssock = _connect(cfg["root_host"], cfg["root_port"])
+    if local:
+        my_addr = cfg["root_host"]
+    else:
+        my_addr = ssock.getsockname()[0]  # our IP as seen en route to sched
+    _send(ssock, ("register_server", (my_addr, port)))
+    _recv(ssock)
+
+    done = threading.Event()
+
+    def handle(conn: socket.socket):
+        try:
+            while True:
+                msg = _recv(conn)
+                kind = msg[0]
+                try:
+                    if kind == "init":
+                        state.init_key(msg[1], _unpack_arr(msg[2]))
+                        _send(conn, ("ok",))
+                    elif kind == "push":
+                        state.push(msg[1], _unpack_arr(msg[2]))
+                        _send(conn, ("ok",))
+                    elif kind == "pull":
+                        _send(conn, ("ok", _pack_arr(state.pull(msg[1]))))
+                    elif kind == "cmd":
+                        head, body = msg[1], msg[2]
+                        if head == _STOP_SERVER:
+                            _send(conn, ("ok",))
+                            done.set()
+                            return
+                        if head == _SYNC_MODE:
+                            state.sync_mode = True
+                        elif head == 0:
+                            state.set_optimizer_blob(body)
+                        _send(conn, ("ok",))
+                except MXNetError as e:
+                    # designed errors go back to the worker, which raises
+                    _send(conn, ("err", str(e)))
+        except (MXNetError, OSError):
+            return
+
+    def acceptor():
+        while not done.is_set():
+            try:
+                conn, _ = lsock.accept()
+            except OSError:
+                return
+            threading.Thread(target=handle, args=(conn,), daemon=True).start()
+
+    threading.Thread(target=acceptor, daemon=True).start()
+    done.wait()
+    time.sleep(0.05)  # drain final acks
+    lsock.close()
+
+
+def _connect(host: str, port: int, retries: int = 100) -> socket.socket:
+    for i in range(retries):
+        try:
+            s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            s.connect((host, port))
+            return s
+        except ConnectionRefusedError:
+            time.sleep(0.05 * min(i + 1, 10))
+    raise MXNetError(f"kvstore: cannot reach {host}:{port}")
+
+
+# ---------------------------------------------------------------------------
+# Worker-side store
+# ---------------------------------------------------------------------------
+
+class DistKVStore(KVStore):
+    """Worker-side distributed store (reference ``KVStoreDist``)."""
+
+    def __init__(self, kind: str = "dist_sync"):
+        super().__init__(kind)
+        cfg = role_from_env()
+        if not cfg:
+            raise MXNetError(
+                "dist kvstore needs a launched cluster: set MXTPU_ROLE / "
+                "MXTPU_PS_ROOT_URI / MXTPU_PS_ROOT_PORT / MXTPU_NUM_WORKER / "
+                "MXTPU_NUM_SERVER (see mxnet_tpu.parallel.launch / "
+                "tools/launch.py)")
+        if cfg["role"] != "worker":
+            raise MXNetError(
+                f"DistKVStore built in role {cfg['role']!r}; non-worker "
+                "processes should call kvstore.create() which runs the "
+                "server/scheduler loop instead")
+        self._cfg = cfg
+        sched = _connect(cfg["root_host"], cfg["root_port"])
+        _send(sched, ("register_worker",))
+        ok = _recv(sched)
+        self._rank = ok[1]
+        self._server_addrs = ok[2]
+        self._sched = sched
+        self._server_socks = [_connect(h, p) for (h, p) in self._server_addrs]
+        self._sock_locks = [threading.Lock() for _ in self._server_socks]
+        self._closed = False
+        atexit.register(self.close)
+        if kind in ("dist_sync", "dist") and self._rank == 0:
+            self.send_command_to_servers(_SYNC_MODE, b"")
+        self.barrier()
+
+    # -- placement ------------------------------------------------------
+
+    @property
+    def rank(self) -> int:
+        return self._rank
+
+    @property
+    def num_workers(self) -> int:
+        return self._cfg["num_workers"]
+
+    def _shards_for(self, key, arr: np.ndarray) -> List[Tuple[int, Any, slice]]:
+        """(server_index, wire_key, flat_slice) placement: hash small keys
+        to one server, stripe big arrays over all (kvstore_dist.h:231-269)."""
+        import zlib
+        ns = len(self._server_socks)
+        if arr.size * arr.itemsize < BIGARRAY_BOUND or ns == 1:
+            # deterministic across processes (Python's str hash is salted)
+            sid = zlib.crc32(str(key).encode()) % ns
+            return [(sid, key, slice(0, arr.size))]
+        out = []
+        per = (arr.size + ns - 1) // ns
+        for i in range(ns):
+            lo, hi = i * per, min((i + 1) * per, arr.size)
+            if lo >= hi:
+                break
+            out.append((i, (key, i), slice(lo, hi)))
+        return out
+
+    def _rpc(self, sid: int, msg) -> Any:
+        with self._sock_locks[sid]:
+            _send(self._server_socks[sid], msg)
+            reply = _recv(self._server_socks[sid])
+        if reply[0] != "ok":
+            raise MXNetError(f"kvstore server error: {reply!r}")
+        return reply
+
+    # -- KVStore API ----------------------------------------------------
+
+    def init(self, key, value) -> None:
+        keys, values = _value_list(key, value)
+        self._meta = getattr(self, "_meta", {})
+        for k, vgroup in zip(keys, values):
+            # placement must be computed from the true dtype on every
+            # worker, or pull would stripe differently than init/push
+            self._meta[k] = (tuple(vgroup[0].shape),
+                             np.dtype(vgroup[0].dtype))
+            if self._rank == 0:
+                arr = vgroup[0].asnumpy()
+                flat = arr.reshape(-1)
+                for sid, wkey, sl in self._shards_for(k, arr):
+                    self._rpc(sid, ("init", wkey, _pack_arr(flat[sl])))
+        self.barrier()
+
+    def _merge_local(self, vgroup: List[NDArray]) -> np.ndarray:
+        """Reduce this worker's per-device grads via XLA collectives before
+        the host push (device tier rides ICI; host hop carries one copy)."""
+        if len(vgroup) == 1:
+            return vgroup[0].asnumpy()
+        from .collectives import allreduce_sum
+        reduced = allreduce_sum([v.data for v in vgroup])
+        return np.asarray(reduced[0])
+
+    def push(self, key, value, priority: int = 0) -> None:
+        keys, values = _value_list(key, value)
+        for k, vgroup in zip(keys, values):
+            arr = self._merge_local(vgroup)
+            flat = arr.reshape(-1)
+            for sid, wkey, sl in self._shards_for(k, arr):
+                self._rpc(sid, ("push", wkey, _pack_arr(flat[sl])))
+
+    def pull(self, key, out=None, priority: int = 0) -> None:
+        keys, outs = _value_list(key, out)
+        for k, ogroup in zip(keys, outs):
+            shape, dtype = self._meta.get(
+                k, (tuple(ogroup[0].shape), np.dtype(ogroup[0].dtype)))
+            probe = np.empty(shape, dtype=dtype)
+            parts = []
+            for sid, wkey, sl in self._shards_for(k, probe):
+                parts.append(_unpack_arr(self._rpc(sid, ("pull", wkey))[1]))
+            merged = np.concatenate([p.reshape(-1) for p in parts]).reshape(shape)
+            for o in ogroup:
+                o._write(merged)
+
+    def set_optimizer(self, optimizer) -> None:
+        """Pickle + broadcast to servers (reference ``kvstore.py:251-254``);
+        workers keep no updater in dist mode."""
+        self._optimizer_blob = pickle.dumps(optimizer)
+        if self._rank == 0:
+            self.send_command_to_servers(0, self._optimizer_blob)
+        self.barrier()
+
+    def set_updater(self, updater) -> None:
+        # server-side updates only (update_on_kvstore mode)
+        self._updater = updater
+
+    def barrier(self) -> None:
+        _send(self._sched, ("barrier",))
+        reply = _recv(self._sched)
+        if reply[0] != "barrier_done":
+            raise MXNetError(f"barrier failed: {reply!r}")
+
+    def send_command_to_servers(self, head: int, body) -> None:
+        if isinstance(body, str):
+            body = body.encode()
+        for sid in range(len(self._server_socks)):
+            self._rpc(sid, ("cmd", head, body))
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self.barrier()
+            if self._rank == 0:
+                self.send_command_to_servers(_STOP_SERVER, b"")
+            _send(self._sched, ("stop",))
+        except (MXNetError, OSError):
+            pass
+        for s in self._server_socks + [self._sched]:
+            try:
+                s.close()
+            except OSError:
+                pass

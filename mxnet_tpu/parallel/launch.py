@@ -1,0 +1,101 @@
+"""Cluster launcher (reference ``tools/launch.py`` analog).
+
+The reference submits scheduler/server/worker processes through
+dmlc-tracker backends (local, ssh, mpi, sge, yarn — ``tools/launch.py:
+42-70``).  Here:
+
+* ``local`` forks everything on this host — the test/bringup path, exactly
+  how the reference nightly validates ``dist_sync``
+  (``tests/nightly/dist_sync_kvstore.py`` with ``--launcher local``);
+* ``ssh`` emits the per-host command lines (zero-egress environments can't
+  spawn remote shells; operators run them via their own fabric);
+* on TPU pods the collective tier needs no launcher at all —
+  ``jax.distributed`` rendezvous via :func:`mxnet_tpu.parallel.dist.
+  init_distributed` replaces the scheduler.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from typing import Dict, List, Optional, Sequence
+
+from ..base import MXNetError
+
+__all__ = ["launch_local", "submit"]
+
+
+def _env_for(role: str, num_workers: int, num_servers: int,
+             root_host: str, root_port: int) -> Dict[str, str]:
+    env = dict(os.environ)
+    env.update({
+        "MXTPU_ROLE": role,
+        "MXTPU_PS_ROOT_URI": root_host,
+        "MXTPU_PS_ROOT_PORT": str(root_port),
+        "MXTPU_NUM_WORKER": str(num_workers),
+        "MXTPU_NUM_SERVER": str(num_servers),
+    })
+    return env
+
+
+def launch_local(cmd: Sequence[str], num_workers: int, num_servers: int = 1,
+                 root_port: int = 9091,
+                 worker_env: Optional[Dict[str, str]] = None,
+                 timeout: Optional[float] = None) -> int:
+    """Fork 1 scheduler + N servers + W workers of ``cmd`` on localhost.
+
+    Server/scheduler processes run the SAME command: their
+    ``kvstore.create('dist*')`` call becomes the blocking server loop
+    (reference ``kvstore_server._init_kvstore_server_module``).  Returns
+    the max worker exit code.
+    """
+    root_host = "127.0.0.1"
+    procs: List[subprocess.Popen] = []
+
+    def spawn(role: str, extra: Optional[Dict[str, str]] = None):
+        env = _env_for(role, num_workers, num_servers, root_host, root_port)
+        if extra:
+            env.update(extra)
+        return subprocess.Popen(list(cmd), env=env)
+
+    sched = spawn("scheduler")
+    procs.append(sched)
+    for _ in range(num_servers):
+        procs.append(spawn("server"))
+    workers = []
+    for i in range(num_workers):
+        w = spawn("worker", dict(worker_env or {}, MXTPU_WORKER_ID=str(i)))
+        workers.append(w)
+        procs.append(w)
+    code = 0
+    try:
+        for w in workers:
+            code = max(code, w.wait(timeout=timeout))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+    return code
+
+
+def submit(args) -> int:
+    """CLI entry used by ``tools/launch.py``."""
+    if args.launcher == "local":
+        return launch_local(args.command, args.num_workers, args.num_servers,
+                            root_port=args.root_port)
+    if args.launcher == "ssh":
+        lines = []
+        for role, count in (("scheduler", 1), ("server", args.num_servers),
+                            ("worker", args.num_workers)):
+            for _ in range(count):
+                envs = _env_for(role, args.num_workers, args.num_servers,
+                                args.root_uri, args.root_port)
+                kv = " ".join(f"{k}={v}" for k, v in envs.items()
+                              if k.startswith("MXTPU_"))
+                lines.append(f"ssh <host> '{kv} {' '.join(args.command)}'")
+        print("\n".join(lines))
+        return 0
+    raise MXNetError(f"unknown launcher {args.launcher!r} (local|ssh)")

@@ -1,0 +1,344 @@
+"""Mesh-sharded training: ONE compiled program over all chips.
+
+This is the TPU-native successor to the reference's data-parallel stack —
+``DataParallelExecutorManager`` + KVStore reduce (``python/mxnet/
+executor_manager.py:180``, ``src/kvstore/kvstore_local.h:135-236``) — where
+Python slices the batch per device, runs one executor per device, and
+funnels gradients through merge buffers.  Here the whole training step
+(forward, backward, gradient all-reduce, optimizer update) is a single
+``jax.jit`` over a named :class:`~jax.sharding.Mesh`:
+
+* the batch is sharded over the ``data`` axis (SPMD replaces Python
+  slicing),
+* params are placed by :class:`ShardingRules` — replicated for pure DP or
+  ``PartitionSpec``-sharded over ``model`` for tensor parallelism (the
+  capability upgrade SURVEY §2.4 flags as absent in the 2016 reference),
+* XLA inserts the gradient ``all-reduce``/``all-gather`` collectives over
+  ICI; there is no host participation in the step at all,
+* the optimizer's functional core (:meth:`mxnet_tpu.optimizer.Optimizer.
+  _functional_step`) runs inside the same program, so updates fuse with the
+  tail of the backward pass (the comm/compute overlap the reference gets
+  from engine priorities, ``model.py:89-99``, falls out of XLA scheduling).
+"""
+from __future__ import annotations
+
+import logging
+import re
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..base import MXNetError
+from ..graph_eval import eval_symbol
+from ..context import Context, cpu
+from ..ndarray import NDArray, array as nd_array
+from .mesh import DATA_AXIS, batch_sharding, data_parallel_mesh, replicated
+
+__all__ = ["ShardingRules", "ShardedTrainer"]
+
+
+class ShardingRules:
+    """Regex -> PartitionSpec placement rules for parameters/activations.
+
+    The analog of the reference's ``group2ctx`` device-placement map
+    (``symbolic.h:366-377``) lifted to mesh axes: instead of pinning a
+    layer to one GPU, a rule shards a weight over mesh axes, e.g.::
+
+        ShardingRules([("fc\\d+_weight", P("model", None))])
+
+    Unmatched params are replicated (pure data parallelism).
+    """
+
+    def __init__(self, rules: Optional[Sequence[Tuple[str, P]]] = None):
+        self._rules = [(re.compile(pat), spec) for pat, spec in (rules or [])]
+
+    def spec_for(self, name: str) -> P:
+        for pat, spec in self._rules:
+            if pat.search(name):
+                return spec
+        return P()
+
+
+class ShardedTrainer:
+    """Compiled data/tensor-parallel trainer for a Symbol.
+
+    Parameters
+    ----------
+    symbol : Symbol
+        Network whose heads are loss outputs (SoftmaxOutput etc. — loss
+        heads define their own backward and ignore head cotangents).
+    optimizer : str or Optimizer
+    mesh : jax.sharding.Mesh, optional
+        Defaults to a 1-D data-parallel mesh over all local devices.
+    rules : ShardingRules, optional
+        Parameter placement (tensor parallelism); default replicated.
+    data_axis : str
+        Mesh axis the batch dim is sharded over.
+    """
+
+    def __init__(self, symbol, optimizer="sgd", optimizer_params=None,
+                 mesh: Optional[Mesh] = None, rules: Optional[ShardingRules] = None,
+                 data_axis: str = DATA_AXIS, initializer=None,
+                 logger=None):
+        from .. import optimizer as opt_mod
+        from ..initializer import Uniform
+        self.symbol = symbol
+        self.mesh = mesh if mesh is not None else data_parallel_mesh()
+        if data_axis not in self.mesh.axis_names:
+            raise MXNetError(f"mesh has no axis {data_axis!r}; "
+                             f"axes: {self.mesh.axis_names}")
+        self.data_axis = data_axis
+        self.rules = rules or ShardingRules()
+        self.initializer = initializer or Uniform(0.07)
+        self.logger = logger or logging.getLogger(__name__)
+        if isinstance(optimizer, str):
+            optimizer = opt_mod.create(optimizer, **(optimizer_params or {}))
+        self.optimizer = optimizer
+        self._bound = False
+
+    # ------------------------------------------------------------------
+    # Bind: infer shapes, initialize + place params, compile the step
+    # ------------------------------------------------------------------
+
+    def bind(self, data_shapes: Dict[str, Tuple[int, ...]],
+             label_shapes: Optional[Dict[str, Tuple[int, ...]]] = None,
+             arg_params: Optional[Dict[str, Any]] = None,
+             aux_params: Optional[Dict[str, Any]] = None) -> "ShardedTrainer":
+        """``data_shapes``/``label_shapes`` carry the GLOBAL batch size —
+        the per-chip shard is batch // mesh.shape[data_axis]."""
+        sym = self.symbol
+        input_shapes = dict(data_shapes)
+        input_shapes.update(label_shapes or {})
+        ndata = self.mesh.shape[self.data_axis]
+        for name, shape in input_shapes.items():
+            if shape[0] % ndata:
+                raise MXNetError(
+                    f"global batch {shape[0]} for {name!r} not divisible by "
+                    f"data-axis size {ndata}")
+        arg_names = sym.list_arguments()
+        self._input_names = [n for n in arg_names if n in input_shapes]
+        self._param_names = [n for n in arg_names if n not in input_shapes]
+        self._aux_names = sym.list_auxiliary_states()
+
+        arg_shapes, _, aux_shapes = sym.infer_shape(**input_shapes)
+        if any(s is None for s in arg_shapes):
+            raise MXNetError("bind: incomplete shape inference")
+        shape_of = dict(zip(arg_names, arg_shapes))
+        self._input_shapes = {n: shape_of[n] for n in self._input_names}
+
+        # initialize on host, then place onto the mesh with the rule's spec
+        host = cpu()
+        params: Dict[str, jax.Array] = {}
+        for n in self._param_names:
+            nd = NDArray(np.zeros(shape_of[n], np.float32), ctx=host)
+            if arg_params and n in arg_params:
+                src = arg_params[n]
+                nd._write(jnp.asarray(src.data if isinstance(src, NDArray)
+                                      else src))
+            else:
+                self.initializer(n, nd)
+            params[n] = jax.device_put(
+                nd.data, NamedSharding(self.mesh, self.rules.spec_for(n)))
+        aux: Dict[str, jax.Array] = {}
+        for n, s in zip(self._aux_names, aux_shapes):
+            nd = NDArray(np.zeros(s, np.float32), ctx=host)
+            if aux_params and n in aux_params:
+                src = aux_params[n]
+                nd._write(jnp.asarray(src.data if isinstance(src, NDArray)
+                                      else src))
+            else:
+                self.initializer(n, nd)
+            aux[n] = jax.device_put(nd.data, replicated(self.mesh))
+
+        opt = self.optimizer
+        opt_state = {n: jax.tree.map(
+            lambda z: jax.device_put(
+                z, NamedSharding(self.mesh, self.rules.spec_for(n))),
+            opt.state_zeros_like(params[n])) for n in self._param_names}
+
+        self._params, self._aux, self._opt_state = params, aux, opt_state
+        self._num_update = opt.begin_num_update
+        self._lr_mult = {n: opt.lr_mult.get(n, 1.0) for n in self._param_names}
+        self._wd_mult = {}
+        for n in self._param_names:
+            if n in opt.wd_mult:
+                self._wd_mult[n] = opt.wd_mult[n]
+            elif n.endswith(("_gamma", "_beta", "_bias")):
+                self._wd_mult[n] = 0.0
+            else:
+                self._wd_mult[n] = 1.0
+        self._compile()
+        self._bound = True
+        return self
+
+    def _compile(self):
+        sym, opt = self.symbol, self.optimizer
+        topo = sym._topo()
+        input_names = list(self._input_names)
+        param_names = list(self._param_names)
+        hyper = opt._hyper()
+        step_fn = type(opt)._functional_step
+        lr_mult, wd_mult = dict(self._lr_mult), dict(self._wd_mult)
+        base_wd = opt.wd
+        needs_rng = type(opt)._needs_rng
+
+        def train_step(params, aux, opt_state, batch, lr, t, rng):
+            def fwd(p):
+                args = dict(p)
+                args.update(batch)
+                heads, auxu = eval_symbol(sym, args, aux, rng, True, topo=topo)
+                return heads, auxu
+            heads, vjp_fn, auxu = jax.vjp(fwd, params, has_aux=True)
+            ones = tuple(jnp.ones(h.shape, h.dtype) for h in heads)
+            (grads,) = vjp_fn(ones)
+            new_params, new_opt = {}, {}
+            for i, n in enumerate(param_names):
+                prng = jax.random.fold_in(rng, i) if needs_rng else None
+                w2, s2 = step_fn(hyper, params[n], grads[n], opt_state[n],
+                                 lr * lr_mult[n], base_wd * wd_mult[n],
+                                 t, prng)
+                new_params[n] = w2
+                new_opt[n] = s2
+            new_aux = dict(aux)
+            new_aux.update(auxu)
+            return new_params, new_aux, new_opt, heads
+
+        def eval_step(params, aux, batch, rng):
+            args = dict(params)
+            args.update(batch)
+            heads, _ = eval_symbol(sym, args, aux, rng, False, topo=topo)
+            return heads
+
+        p_shard = {n: NamedSharding(self.mesh, self.rules.spec_for(n))
+                   for n in param_names}
+        a_shard = {n: replicated(self.mesh) for n in self._aux_names}
+        o_shard = {n: jax.tree.map(lambda _, _s=p_shard[n]: _s,
+                                   self._opt_state[n]) for n in param_names}
+        self._train_step = jax.jit(
+            train_step,
+            out_shardings=(p_shard, a_shard, o_shard, None),
+            donate_argnums=(0, 1, 2))
+        self._eval_step = jax.jit(eval_step)
+
+    # ------------------------------------------------------------------
+    # Stepping
+    # ------------------------------------------------------------------
+
+    def _place_batch(self, batch) -> Dict[str, jax.Array]:
+        """Accept a DataBatch / dict / aligned list; shard dim 0 over the
+        data axis."""
+        sh = batch_sharding(self.mesh, self.data_axis)
+        if hasattr(batch, "data"):  # DataBatch
+            vals = list(batch.data) + list(batch.label or [])
+            named = dict(zip(self._input_names, vals))
+        elif isinstance(batch, dict):
+            named = batch
+        else:
+            named = dict(zip(self._input_names, batch))
+        out = {}
+        for n in self._input_names:
+            v = named[n]
+            v = v.data if isinstance(v, NDArray) else jnp.asarray(v)
+            out[n] = jax.device_put(v, sh)
+        return out
+
+    def step(self, batch) -> List[jax.Array]:
+        """Run one training step; returns the head outputs (global arrays)."""
+        if not self._bound:
+            raise MXNetError("call bind() before step()")
+        from .. import random as _random
+        self._num_update += 1
+        opt = self.optimizer
+        lr = (opt.lr_scheduler(self._num_update) if opt.lr_scheduler
+              else opt.lr)
+        placed = self._place_batch(batch)
+        self._params, self._aux, self._opt_state, heads = self._train_step(
+            self._params, self._aux, self._opt_state, placed,
+            lr, self._num_update, _random._next_key())
+        return list(heads)
+
+    def forward(self, batch) -> List[jax.Array]:
+        """Inference forward (no aux update, no dropout)."""
+        from .. import random as _random
+        placed = self._place_batch(batch)
+        return list(self._eval_step(self._params, self._aux, placed,
+                                    _random._next_key()))
+
+    # ------------------------------------------------------------------
+    # Param access / training loop
+    # ------------------------------------------------------------------
+
+    def get_params(self) -> Tuple[Dict[str, NDArray], Dict[str, NDArray]]:
+        arg = {n: nd_array(np.asarray(v)) for n, v in self._params.items()}
+        aux = {n: nd_array(np.asarray(v)) for n, v in self._aux.items()}
+        return arg, aux
+
+    def set_params(self, arg_params, aux_params=None) -> None:
+        for n, v in (arg_params or {}).items():
+            if n in self._params:
+                val = v.data if isinstance(v, NDArray) else jnp.asarray(v)
+                self._params[n] = jax.device_put(
+                    val, NamedSharding(self.mesh, self.rules.spec_for(n)))
+        for n, v in (aux_params or {}).items():
+            if n in self._aux:
+                val = v.data if isinstance(v, NDArray) else jnp.asarray(v)
+                self._aux[n] = jax.device_put(val, replicated(self.mesh))
+
+    def score(self, eval_data, eval_metric):
+        from ..metric import create as metric_create
+        if isinstance(eval_metric, str):
+            eval_metric = metric_create(eval_metric)
+        eval_metric.reset()
+        eval_data.reset()
+        for batch in eval_data:
+            outs = self.forward(batch)
+            eval_metric.update(batch.label, [NDArray(np.asarray(o))
+                                             for o in outs])
+        return eval_metric
+
+    def fit(self, train_data, eval_data=None, eval_metric="acc",
+            num_epoch: int = 1, batch_end_callback=None,
+            epoch_end_callback=None) -> None:
+        """Mesh-native training loop: per batch, one compiled device step.
+
+        Unlike the reference loop (``model.py:119``) there is no push/pull
+        phase — gradient reduction is inside :meth:`step`.
+        """
+        from ..metric import create as metric_create
+        if isinstance(eval_metric, str):
+            eval_metric = metric_create(eval_metric)
+        for epoch in range(num_epoch):
+            tic = time.time()
+            eval_metric.reset()
+            nbatch = 0
+            train_data.reset()
+            for batch in train_data:
+                outs = self.step(batch)
+                eval_metric.update(batch.label,
+                                   [NDArray(np.asarray(o)) for o in outs])
+                nbatch += 1
+                if batch_end_callback is not None:
+                    from ..model import BatchEndParam
+                    batch_end_callback(BatchEndParam(
+                        epoch=epoch, nbatch=nbatch, eval_metric=eval_metric,
+                        locals=locals()))
+            name, value = eval_metric.get()
+            names = name if isinstance(name, list) else [name]
+            values = value if isinstance(value, list) else [value]
+            for n_, v_ in zip(names, values):
+                self.logger.info("Epoch[%d] Mesh-Train-%s=%f", epoch, n_, v_)
+            self.logger.info("Epoch[%d] Step-total=%d Elapsed=%.3fs",
+                             epoch, nbatch, time.time() - tic)
+            if epoch_end_callback is not None:
+                arg_p, aux_p = self.get_params()
+                epoch_end_callback(epoch, self.symbol, arg_p, aux_p)
+            if eval_data is not None:
+                m = self.score(eval_data, eval_metric)
+                for name, value in [m.get()]:
+                    self.logger.info("Epoch[%d] Mesh-Validation-%s=%s",
+                                     epoch, name, value)

@@ -1,0 +1,53 @@
+"""Worker program for the dist kvstore exact-aggregation test.
+
+Run by ``mxnet_tpu.parallel.launch.launch_local`` in all three roles (the
+role env decides behavior inside ``kvstore.create``).  Parity target:
+``/root/reference/tests/nightly/dist_sync_kvstore.py:20-46`` — integer
+tensors, ``sum = (n+1)n/2 * rate * nrepeat + init``, plus one key above
+the big-array bound to exercise server striping.
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("MXNET_KVSTORE_BIGARRAY_BOUND", "4096")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def main():
+    kv = mx.kvstore.create("dist_sync")  # non-workers never return
+    # pickled-optimizer broadcast (reference kvstore.py:251-254): the Test
+    # optimizer does w += g on the SERVER, so pushes accumulate
+    kv.set_optimizer(mx.optimizer.create("test"))
+    rate = 2
+    nrepeat = 3
+    shape_small = (3, 3)
+    shape_big = (50, 50)  # 10000 B > 4096 bound -> striped over servers
+
+    kv.init(3, mx.nd.ones(shape_small))
+    kv.init(99, mx.nd.ones(shape_big))
+    my_rank = kv.rank
+    nworker = kv.num_workers
+
+    for _ in range(nrepeat):
+        kv.push(3, mx.nd.ones(shape_small) * (my_rank + 1) * rate)
+        kv.push(99, mx.nd.ones(shape_big) * (my_rank + 1) * rate)
+    out_s = mx.nd.zeros(shape_small)
+    out_b = mx.nd.zeros(shape_big)
+    kv.pull(3, out=out_s)
+    kv.pull(99, out=out_b)
+    # init 1 + nrepeat rounds of sum_i (i+1)*rate  (dist_sync_kvstore.py:33-46)
+    expect = nworker * (nworker + 1) / 2 * rate * nrepeat + 1
+    np.testing.assert_array_equal(out_s.asnumpy(), expect)
+    np.testing.assert_array_equal(out_b.asnumpy(), expect)
+    kv.close()
+    print(f"worker {my_rank}: dist_sync exact aggregation ok", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,31 @@
+"""Distributed kvstore: real local processes, exact aggregation.
+
+The reference validates ``dist_sync`` by launching scheduler + servers +
+workers all on localhost and asserting integer aggregation
+(``tests/nightly/dist_sync_kvstore.py``, ``tools/launch.py --launcher
+local``); same strategy here.
+"""
+import os
+import sys
+
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.parallel.launch import launch_local
+
+
+def test_dist_kvstore_requires_cluster_env(monkeypatch):
+    for v in ("MXTPU_ROLE", "DMLC_ROLE"):
+        monkeypatch.delenv(v, raising=False)
+    with pytest.raises(mx.base.MXNetError, match="launch"):
+        mx.kvstore.create("dist_sync")
+
+
+@pytest.mark.parametrize("num_workers,num_servers", [(2, 1), (3, 2)])
+def test_dist_sync_exact_aggregation(num_workers, num_servers):
+    script = os.path.join(os.path.dirname(__file__), "dist_sync_worker.py")
+    code = launch_local([sys.executable, script], num_workers=num_workers,
+                        num_servers=num_servers,
+                        root_port=19300 + num_workers * 10 + num_servers,
+                        timeout=120)
+    assert code == 0

@@ -1,0 +1,164 @@
+"""Multi-chip tests on the virtual 8-device CPU mesh.
+
+Exact-arithmetic assertions in the style of the reference's distributed
+tests (``tests/nightly/dist_sync_kvstore.py:20-46``): integer-valued
+tensors make collective reductions bit-exact.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+import mxnet_tpu as mx
+from mxnet_tpu import parallel
+from mxnet_tpu.parallel import (ShardedTrainer, ShardingRules, allreduce_sum,
+                                data_parallel_mesh, make_mesh)
+
+
+def _devices():
+    return jax.devices()
+
+
+def test_make_mesh_axes():
+    mesh = make_mesh({"data": 4, "model": 2})
+    assert mesh.shape == {"data": 4, "model": 2}
+    mesh = make_mesh({"data": -1})
+    assert mesh.shape["data"] == len(_devices())
+    with pytest.raises(mx.base.MXNetError):
+        make_mesh({"data": 3})  # 8 devices not divisible
+
+
+def test_allreduce_sum_exact():
+    devs = _devices()
+    n = len(devs)
+    # worker i contributes (i+1) * ones — total n(n+1)/2, the reference's
+    # dist_sync_kvstore arithmetic
+    arrays = [jax.device_put(jnp.full((4, 3), i + 1, jnp.float32), d)
+              for i, d in enumerate(devs)]
+    out = allreduce_sum(arrays)
+    expect = n * (n + 1) / 2
+    for o, d in zip(out, devs):
+        assert next(iter(o.devices())) == d
+        np.testing.assert_array_equal(np.asarray(o), expect)
+
+
+def test_allreduce_co_resident_fallback():
+    d0 = _devices()[0]
+    arrays = [jax.device_put(jnp.full((2,), i + 1, jnp.float32), d0)
+              for i in range(3)]
+    out = allreduce_sum(arrays)
+    np.testing.assert_array_equal(np.asarray(out[0]), 6)
+
+
+def test_kvstore_local_collective_reduce():
+    """KVStore.push over per-device shards reduces without a host funnel
+    and returns the exact sum."""
+    kv = mx.kvstore.create("local")
+    devs = _devices()[:4]
+    shape = (3, 2)
+    kv.init(3, mx.nd.zeros(shape))
+    vals = [mx.nd.NDArray(jax.device_put(jnp.full(shape, i + 1, jnp.float32), d))
+            for i, d in enumerate(devs)]
+    kv.push(3, vals)
+    out = mx.nd.zeros(shape)
+    kv.pull(3, out=out)
+    np.testing.assert_array_equal(out.asnumpy(), 10.0)
+
+
+def _mlp():
+    data = mx.symbol.Variable("data")
+    fc1 = mx.symbol.FullyConnected(data=data, name="fc1", num_hidden=16)
+    act = mx.symbol.Activation(data=fc1, name="relu1", act_type="relu")
+    fc2 = mx.symbol.FullyConnected(data=act, name="fc2", num_hidden=4)
+    return mx.symbol.SoftmaxOutput(data=fc2, name="softmax")
+
+
+def _toy_batch(n=32, seed=0):
+    rs = np.random.RandomState(seed)
+    x = rs.randn(n, 8).astype(np.float32)
+    w = rs.randn(8, 4).astype(np.float32)
+    y = np.argmax(x @ w, axis=1).astype(np.float32)
+    return x, y
+
+
+def test_sharded_trainer_dp_matches_single_device():
+    """Same init + same global batch => identical params whether the mesh
+    has 1 or 8 devices (data parallelism is arithmetic-neutral)."""
+    sym = _mlp()
+    x, y = _toy_batch(32)
+
+    def run(mesh):
+        mx.random.seed(7)
+        tr = ShardedTrainer(sym, optimizer="sgd",
+                            optimizer_params={"learning_rate": 0.1,
+                                              "momentum": 0.9},
+                            mesh=mesh)
+        tr.bind({"data": (32, 8)}, {"softmax_label": (32,)})
+        for _ in range(3):
+            tr.step({"data": x, "softmax_label": y})
+        return tr.get_params()[0]
+
+    p1 = run(data_parallel_mesh(1))
+    p8 = run(data_parallel_mesh())
+    for n in p1:
+        np.testing.assert_allclose(p1[n].asnumpy(), p8[n].asnumpy(),
+                                   rtol=2e-5, atol=2e-6)
+
+
+def test_sharded_trainer_tensor_parallel():
+    """fc weights sharded over the model axis compute the same math."""
+    sym = _mlp()
+    x, y = _toy_batch(16, seed=1)
+    rules = ShardingRules([(r"fc\d+_weight", P("model", None))])
+
+    def run(mesh, rules_):
+        mx.random.seed(11)
+        tr = ShardedTrainer(sym, optimizer="sgd",
+                            optimizer_params={"learning_rate": 0.05},
+                            mesh=mesh, rules=rules_)
+        tr.bind({"data": (16, 8)}, {"softmax_label": (16,)})
+        for _ in range(2):
+            tr.step({"data": x, "softmax_label": y})
+        return tr.get_params()[0]
+
+    ref = run(data_parallel_mesh(1), ShardingRules())
+    tp = run(make_mesh({"data": 4, "model": 2}), rules)
+    for n in ref:
+        np.testing.assert_allclose(ref[n].asnumpy(), tp[n].asnumpy(),
+                                   rtol=2e-5, atol=2e-6)
+
+
+def test_sharded_trainer_fit_improves():
+    sym = _mlp()
+    x, y = _toy_batch(256, seed=3)
+    train = mx.io.NDArrayIter(x, y, batch_size=64, shuffle=False)
+    tr = ShardedTrainer(sym, optimizer="sgd",
+                        optimizer_params={"learning_rate": 0.005,
+                                          "momentum": 0.9},
+                        mesh=data_parallel_mesh())
+    tr.bind({"data": (64, 8)}, {"softmax_label": (64,)})
+    tr.fit(train, num_epoch=10)
+    m = tr.score(mx.io.NDArrayIter(x, y, batch_size=64), "acc")
+    assert m.get()[1] > 0.7
+
+
+def test_sharded_trainer_aux_states_update():
+    """BatchNorm moving stats update inside the compiled step and stay
+    replicated."""
+    data = mx.symbol.Variable("data")
+    bn = mx.symbol.BatchNorm(data=data, name="bn1")
+    fc = mx.symbol.FullyConnected(data=bn, name="fc1", num_hidden=2)
+    sym = mx.symbol.SoftmaxOutput(data=fc, name="softmax")
+    tr = ShardedTrainer(sym, optimizer="sgd",
+                        optimizer_params={"learning_rate": 0.01},
+                        mesh=data_parallel_mesh())
+    tr.bind({"data": (16, 6)}, {"softmax_label": (16,)})
+    x = np.random.RandomState(0).randn(16, 6).astype(np.float32) * 3 + 1
+    y = np.zeros((16,), np.float32)
+    before = {n: np.asarray(v).copy() for n, v in tr._aux.items()}
+    tr.step({"data": x, "softmax_label": y})
+    moved = any(not np.allclose(before[n], np.asarray(v))
+                for n, v in tr._aux.items())
+    assert moved, "moving stats never updated"

@@ -1,0 +1,38 @@
+#!/usr/bin/env python
+"""Launch a distributed mxnet_tpu job (reference ``tools/launch.py`` analog).
+
+Example (4 workers, 2 servers, all on localhost)::
+
+    python tools/launch.py -n 4 -s 2 --launcher local \
+        python train.py --kv-store dist_sync
+
+Every spawned process runs the same command; role env vars make
+``kvstore.create('dist*')`` act as scheduler/server/worker.  The ``ssh``
+launcher prints per-host command lines instead of executing them.  On TPU
+pods, prefer the collective tier (``mxnet_tpu.parallel.dist``) which needs
+no launcher.
+"""
+import argparse
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from mxnet_tpu.parallel.launch import submit  # noqa: E402
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("-n", "--num-workers", type=int, required=True)
+    ap.add_argument("-s", "--num-servers", type=int, default=1)
+    ap.add_argument("--launcher", default="local", choices=["local", "ssh"])
+    ap.add_argument("--root-uri", default="127.0.0.1")
+    ap.add_argument("--root-port", type=int, default=9091)
+    ap.add_argument("command", nargs=argparse.REMAINDER)
+    args = ap.parse_args()
+    if not args.command:
+        ap.error("no command given")
+    sys.exit(submit(args))
+
+
+if __name__ == "__main__":
+    main()
