@@ -1,0 +1,81 @@
+"""Headline benchmark: CIFAR-10 inception-bn-28-small training throughput.
+
+Mirrors the reference's headline number — 842 img/s on 1x GTX 980, batch
+128 (example/image-classification/README.md:204-206, BASELINE.md row 1) —
+on one TPU chip: full training steps (forward + backward + SGD-momentum
+update compiled as a single XLA program) over synthetic CIFAR-shaped data.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+"""
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+BASELINE_IMG_S = 842.0  # 1-GPU inception-bn-28-small, batch 128
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--network", default="inception-bn-28-small")
+    ap.add_argument("--batch-size", type=int, default=128)
+    ap.add_argument("--image-shape", default="3,28,28")
+    def _positive(v):
+        v = int(v)
+        if v < 1:
+            raise argparse.ArgumentTypeError("must be >= 1")
+        return v
+
+    ap.add_argument("--warmup", type=_positive, default=10)
+    ap.add_argument("--steps", type=_positive, default=50)
+    args = ap.parse_args()
+
+    import jax
+    from mxnet_tpu import models
+    from mxnet_tpu.parallel import ShardedTrainer, make_mesh
+
+    image = tuple(int(x) for x in args.image_shape.split(","))
+    batch = args.batch_size
+    sym = models.get_symbol(args.network, num_classes=10)
+
+    mesh = make_mesh({"data": len(jax.devices())})
+    trainer = ShardedTrainer(
+        sym, mesh=mesh, optimizer="sgd",
+        optimizer_params={"learning_rate": 0.05, "momentum": 0.9,
+                          "wd": 0.0001})
+    trainer.bind(data_shapes={"data": (batch,) + image},
+                 label_shapes={"softmax_label": (batch,)})
+
+    rng = np.random.RandomState(0)
+    data = rng.rand(batch, *image).astype(np.float32)
+    label = rng.randint(0, 10, (batch,)).astype(np.float32)
+    feed = {"data": data, "softmax_label": label}
+
+    for _ in range(args.warmup):
+        heads = trainer.step(feed)
+    jax.block_until_ready(heads)
+
+    tic = time.perf_counter()
+    for _ in range(args.steps):
+        heads = trainer.step(feed)
+    jax.block_until_ready(heads)
+    elapsed = time.perf_counter() - tic
+
+    img_s = args.steps * batch / elapsed
+    result = {
+        "metric": f"{args.network} train throughput (batch {batch}, "
+                  f"{jax.devices()[0].device_kind})",
+        "value": round(img_s, 1),
+        "unit": "img/s",
+        "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
+        "step_ms": round(1000 * elapsed / args.steps, 2),
+        "n_devices": len(jax.devices()),
+    }
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,30 @@
+"""Model zoo: Symbol constructors for the reference's example networks.
+
+Parity targets live under ``/root/reference/example/image-classification/``
+(``train_mnist.py:19-57`` mlp/lenet, ``symbol_inception-bn-28-small.py``,
+``symbol_resnet-28-small.py``, ``symbol_resnet.py``, ``symbol_alexnet.py``,
+``symbol_vgg.py``).  Constructors here rebuild the same architectures on the
+TPU-native Symbol API; they are fresh implementations, not transcriptions.
+"""
+from .mnist import mlp, lenet
+from .inception import inception_bn_small
+from .resnet import resnet_cifar, resnet
+from .classic import alexnet, vgg
+
+_ZOO = {
+    "mlp": mlp,
+    "lenet": lenet,
+    "inception-bn-28-small": inception_bn_small,
+    "resnet-28-small": resnet_cifar,
+    "resnet": resnet,
+    "alexnet": alexnet,
+    "vgg": vgg,
+}
+
+
+def get_symbol(name, **kwargs):
+    """Look up a zoo network by its reference config name."""
+    if name not in _ZOO:
+        raise ValueError(
+            f"unknown network {name!r}; available: {sorted(_ZOO)}")
+    return _ZOO[name](**kwargs)

@@ -1,0 +1,48 @@
+"""Inception-BN family (reference: symbol_inception-bn-28-small.py).
+
+``inception_bn_small`` is the CIFAR-10 headline-benchmark network — the
+842 img/s (1x GTX 980, batch 128) row in BASELINE.md comes from this config
+(example/image-classification/README.md:204-206).
+"""
+from .. import symbol as sym
+
+
+def _conv_bn_relu(data, num_filter, kernel, stride=(1, 1), pad=(0, 0)):
+    net = sym.Convolution(data=data, num_filter=num_filter, kernel=kernel,
+                          stride=stride, pad=pad)
+    net = sym.BatchNorm(data=net)
+    return sym.Activation(data=net, act_type="relu")
+
+
+def _mixed(data, ch_1x1, ch_3x3):
+    """Two-branch inception unit: 1x1 and padded 3x3, channel-concatenated."""
+    a = _conv_bn_relu(data, ch_1x1, (1, 1))
+    b = _conv_bn_relu(data, ch_3x3, (3, 3), pad=(1, 1))
+    return sym.Concat(a, b)
+
+
+def _reduce(data, ch_3x3):
+    """Stride-2 reduction: 3x3 conv branch next to a stride-2 max pool."""
+    a = _conv_bn_relu(data, ch_3x3, (3, 3), stride=(2, 2), pad=(1, 1))
+    b = sym.Pooling(data=data, kernel=(3, 3), stride=(2, 2), pool_type="max")
+    return sym.Concat(a, b)
+
+
+# (ch_1x1, ch_3x3) per mixed unit, None marking the two reductions; matches
+# the in3a..in5b stack of symbol_inception-bn-28-small.py:43-52.
+_STACK = [(32, 32), (32, 48), 80, (112, 48), (96, 64), (80, 80),
+          (48, 96), 96, (176, 160), (176, 160)]
+
+
+def inception_bn_small(num_classes=10):
+    net = _conv_bn_relu(sym.Variable("data"), 96, (3, 3), pad=(1, 1))
+    for spec in _STACK:
+        if isinstance(spec, tuple):
+            net = _mixed(net, *spec)
+        else:
+            net = _reduce(net, spec)
+    net = sym.Pooling(data=net, pool_type="avg", kernel=(7, 7),
+                      name="global_pool")
+    net = sym.Flatten(data=net, name="flatten1")
+    net = sym.FullyConnected(data=net, num_hidden=num_classes, name="fc1")
+    return sym.SoftmaxOutput(data=net, name="softmax")

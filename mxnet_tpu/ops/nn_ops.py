@@ -357,12 +357,15 @@ def _pool_fwd(ctx, params, x):
     # of windows the reference produces
     extra_h = max(0, (oh - 1) * sh + kh - (h + 2 * ph))
     extra_w = max(0, (ow - 1) * sw + kw - (w + 2 * pw))
+    # init must be a CONCRETE scalar: a traced/array init defeats XLA's
+    # monoid-reducer recognition and reverse-mode AD of the reduce_window
+    # fails during jit partial-eval linearization
     if ptype == "max":
-        init, op = -jnp.inf, jax.lax.max
+        init, op = np.asarray(-np.inf, x.dtype), jax.lax.max
     else:
-        init, op = 0.0, jax.lax.add
+        init, op = np.asarray(0.0, x.dtype), jax.lax.add
     out = jax.lax.reduce_window(
-        x, jnp.asarray(init, x.dtype), op,
+        x, init, op,
         window_dimensions=(1, 1, kh, kw),
         window_strides=(1, 1, sh, sw),
         padding=((0, 0), (0, 0), (ph, ph + extra_h), (pw, pw + extra_w)),

@@ -15,6 +15,8 @@ slicing (SURVEY §2.4).  The TPU-native stack has three layers:
   (``tools/launch.py``), and ``jax.distributed`` rendezvous for the
   collective pod path.
 """
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
 from .mesh import (DATA_AXIS, EXPERT_AXIS, MODEL_AXIS, PIPE_AXIS, SEQ_AXIS,
                    batch_sharding, current_mesh, data_parallel_mesh,
                    default_mesh, make_mesh, param_sharding, replicated)
@@ -22,6 +24,7 @@ from .collectives import allreduce_mean, allreduce_sum
 from .trainer import ShardedTrainer, ShardingRules
 
 __all__ = [
+    "Mesh", "NamedSharding", "PartitionSpec",
     "DATA_AXIS", "MODEL_AXIS", "SEQ_AXIS", "PIPE_AXIS", "EXPERT_AXIS",
     "make_mesh", "data_parallel_mesh", "default_mesh", "current_mesh",
     "batch_sharding", "param_sharding", "replicated",

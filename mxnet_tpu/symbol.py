@@ -562,6 +562,10 @@ def _make_symbol_function(opname: str, func_name: str):
             else:
                 str_params[k] = v if isinstance(v, str) else str(
                     tuple(v) if isinstance(v, (list, tuple)) else v)
+        # variadic ops (Concat, ElementWiseSum): num_args defaults to the
+        # number of symbol inputs, as in the reference Python frontend
+        if "num_args" in op.params and "num_args" not in str_params:
+            str_params["num_args"] = str(len(sym_args) + len(sym_kwargs))
         # positional scalars fill declared params in order (rare; parity with
         # the generated ndarray functions)
         if pos_scalars:
