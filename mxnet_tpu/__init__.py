@@ -41,6 +41,9 @@ from .optimizer import Optimizer
 from . import metric
 from . import callback
 from . import io
+from . import recordio
+from . import image_io
+from .image_io import ImageRecordIter
 from . import kvstore
 from . import executor_manager
 from . import model
@@ -55,6 +58,7 @@ __all__ = [
     "nd", "ndarray", "random", "ops", "symbol", "sym", "Symbol",
     "Variable", "Group", "executor", "Executor", "AttrScope", "name",
     "attribute", "initializer", "optimizer", "metric", "callback", "io",
+    "recordio", "image_io", "ImageRecordIter",
     "kvstore", "executor_manager", "model", "FeedForward", "lr_scheduler",
     "Initializer", "Uniform", "Normal", "Xavier", "Orthogonal", "Optimizer",
     "save_checkpoint", "load_checkpoint",

@@ -8,6 +8,7 @@ with reference-era scripts (it resolves to the accelerator backend).
 """
 from __future__ import annotations
 
+import functools
 import threading
 from typing import Optional
 
@@ -16,6 +17,27 @@ import jax
 from .base import MXNetError
 
 __all__ = ["Context", "cpu", "tpu", "gpu", "current_context", "num_devices", "default_ctx"]
+
+
+@functools.lru_cache(maxsize=1)
+def _accel_platform() -> Optional[str]:
+    """Name of a live non-cpu platform, else None (cached: the platform
+    set is immutable once the backend is initialized).
+
+    Checks the default backend first, then secondary registered platforms
+    (``jax_platforms="cpu,tpu"`` keeps cpu as default while the real chip
+    stays reachable — the dual-lane test setup).
+    """
+    for d in jax.devices():
+        if d.platform != "cpu":
+            return d.platform
+    for name in ("tpu", "axon"):
+        try:
+            if jax.devices(name):
+                return name
+        except RuntimeError:
+            continue
+    return None
 
 
 class Context:
@@ -48,11 +70,7 @@ class Context:
         return self.devtype2mask[self.device_type]
 
     def _accelerator_platform(self) -> Optional[str]:
-        """Name of the non-cpu platform if one is live, else None."""
-        for d in jax.devices():
-            if d.platform != "cpu":
-                return d.platform
-        return None
+        return _accel_platform()
 
     @property
     def jax_device(self) -> jax.Device:
@@ -65,7 +83,7 @@ class Context:
                 # to cpu devices so ctx lists like [tpu(0), tpu(1)] still map
                 # onto the virtual device mesh.
                 platform = "cpu"
-            devices = [d for d in jax.devices() if d.platform == platform]
+            devices = jax.devices(platform)
         elif dt in ("cpu", "cpu_pinned"):
             try:
                 devices = jax.devices("cpu")
@@ -127,7 +145,13 @@ def current_context() -> Context:
 
 
 def default_ctx() -> Context:
-    """Best single-device context for this process: tpu if present else cpu."""
+    """Best single-device context for this process: tpu if present else cpu.
+
+    Only consults the DEFAULT backend: when the accelerator is registered
+    as a secondary platform (dual-lane test setup, cpu first), untyped
+    NDArrays stay on cpu and only explicit ``tpu()`` contexts reach the
+    chip.
+    """
     for d in jax.devices():
         if d.platform != "cpu":
             return Context("tpu", 0)
@@ -137,10 +161,10 @@ def default_ctx() -> Context:
 def num_devices(device_type: str = "tpu") -> int:
     """Number of visible devices of the given type."""
     if device_type in ("tpu", "gpu"):
-        n = len([d for d in jax.devices() if d.platform != "cpu"])
-        if n == 0:
-            n = len(jax.devices())
-        return n
+        platform = _accel_platform()
+        if platform is None:
+            return len(jax.devices())
+        return len(jax.devices(platform))
     try:
         return len(jax.devices("cpu"))
     except RuntimeError:

@@ -146,6 +146,12 @@ class NDArray:
         if not self.writable:
             raise MXNetError("trying to write to a read-only NDArray")
         value = jnp.asarray(value, dtype=self.dtype)
+        # storage keeps its placement: cross-device writes transfer the
+        # value (reference CopyFromTo semantics, ndarray.cc:226-287) rather
+        # than silently migrating the chunk off its bound device
+        if (isinstance(value, jax.Array)
+                and value.sharding != self._chunk.data.sharding):
+            value = jax.device_put(value, self._chunk.data.sharding)
         value = jnp.broadcast_to(value, self._shape)
         if not self._is_view:
             self._chunk.write(value.reshape(self._chunk.data.shape))
@@ -162,7 +168,12 @@ class NDArray:
             self._write(jnp.asarray(value))
             return
         cur = self.data
-        new = cur.at[key].set(jnp.asarray(value, dtype=self.dtype))
+        value = jnp.asarray(value, dtype=self.dtype)
+        # cross-device partial writes transfer the value first (CopyFromTo
+        # semantics) so .at[].set doesn't see mixed committed devices
+        if isinstance(value, jax.Array) and value.sharding != cur.sharding:
+            value = jax.device_put(value, cur.sharding)
+        new = cur.at[key].set(value)
         self._write(new)
 
     def __getitem__(self, key) -> "NDArray":

@@ -1,0 +1,142 @@
+// RecordIO: magic-framed binary record format + reader/writer C API.
+//
+// TPU-native equivalent of the reference's dmlc-core RecordIO layer (used
+// by src/io/iter_image_recordio.cc and python/mxnet/recordio.py through
+// MXRecordIO* C API calls).  Same on-disk framing so packed datasets are
+// interchangeable:
+//   [kMagic u32][lrec u32][payload][pad to 4B]
+// where lrec = (cflag << 29) | length; cflag 0 = whole record,
+// 1/2/3 = first/middle/last chunk of a record split across frames.
+//
+// Exposed as a flat C API (ctypes-loadable, no pybind11 dependency):
+//   MXTRecordIOWriterCreate / WriteRecord / Tell / Free
+//   MXTRecordIOReaderCreate / ReadRecord / Seek / Free
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0xced7230a;
+constexpr uint32_t kLenMask = (1u << 29) - 1;
+
+struct Writer {
+  FILE* fp;
+};
+
+struct Reader {
+  FILE* fp;
+  std::vector<char> buf;  // last returned record payload
+};
+
+inline uint32_t EncodeL(uint32_t cflag, uint32_t len) {
+  return (cflag << 29) | (len & kLenMask);
+}
+
+}  // namespace
+
+extern "C" {
+
+void* MXTRecordIOWriterCreate(const char* path) {
+  FILE* fp = std::fopen(path, "wb");
+  if (!fp) return nullptr;
+  return new Writer{fp};
+}
+
+// Returns 0 on success.
+int MXTRecordIOWriterWriteRecord(void* handle, const char* data, size_t size) {
+  Writer* w = static_cast<Writer*>(handle);
+  if (!w || !w->fp) return -1;
+  // Split payloads >= 2^29 across continuation frames.
+  size_t off = 0;
+  bool first = true;
+  do {
+    size_t chunk = size - off;
+    bool last = chunk <= kLenMask;
+    if (!last) chunk = kLenMask;
+    uint32_t cflag = first ? (last ? 0u : 1u) : (last ? 3u : 2u);
+    uint32_t head[2] = {kMagic, EncodeL(cflag, static_cast<uint32_t>(chunk))};
+    if (std::fwrite(head, sizeof(head), 1, w->fp) != 1) return -1;
+    if (chunk && std::fwrite(data + off, 1, chunk, w->fp) != chunk) return -1;
+    static const char zeros[4] = {0, 0, 0, 0};
+    size_t pad = (4 - (chunk & 3)) & 3;
+    if (pad && std::fwrite(zeros, 1, pad, w->fp) != pad) return -1;
+    off += chunk;
+    first = false;
+  } while (off < size);
+  return 0;
+}
+
+long MXTRecordIOWriterTell(void* handle) {
+  Writer* w = static_cast<Writer*>(handle);
+  return w && w->fp ? std::ftell(w->fp) : -1;
+}
+
+void MXTRecordIOWriterFree(void* handle) {
+  Writer* w = static_cast<Writer*>(handle);
+  if (w) {
+    if (w->fp) std::fclose(w->fp);
+    delete w;
+  }
+}
+
+void* MXTRecordIOReaderCreate(const char* path) {
+  FILE* fp = std::fopen(path, "rb");
+  if (!fp) return nullptr;
+  return new Reader{fp, {}};
+}
+
+// Reads the next logical record (joining continuation frames).
+// Returns 0 with *out/*size set; 1 on clean EOF; -1 on corruption.
+int MXTRecordIOReaderReadRecord(void* handle, const char** out, size_t* size) {
+  Reader* r = static_cast<Reader*>(handle);
+  if (!r || !r->fp) return -1;
+  r->buf.clear();
+  bool in_multi = false;
+  for (;;) {
+    uint32_t head[2];
+    size_t n = std::fread(head, sizeof(uint32_t), 2, r->fp);
+    if (n == 0 && !in_multi) return 1;  // EOF at frame boundary
+    if (n != 2) return -1;
+    if (head[0] != kMagic) return -1;
+    uint32_t cflag = head[1] >> 29;
+    uint32_t len = head[1] & kLenMask;
+    size_t old = r->buf.size();
+    r->buf.resize(old + len);
+    if (len && std::fread(r->buf.data() + old, 1, len, r->fp) != len)
+      return -1;
+    size_t pad = (4 - (len & 3)) & 3;
+    if (pad) std::fseek(r->fp, static_cast<long>(pad), SEEK_CUR);
+    if (cflag == 0 && !in_multi) break;
+    if (cflag == 1 && !in_multi) { in_multi = true; continue; }
+    if (cflag == 2 && in_multi) continue;
+    if (cflag == 3 && in_multi) break;
+    return -1;  // continuation flags out of order
+  }
+  *out = r->buf.data();
+  *size = r->buf.size();
+  return 0;
+}
+
+int MXTRecordIOReaderSeek(void* handle, long pos) {
+  Reader* r = static_cast<Reader*>(handle);
+  if (!r || !r->fp) return -1;
+  return std::fseek(r->fp, pos, SEEK_SET) == 0 ? 0 : -1;
+}
+
+long MXTRecordIOReaderTell(void* handle) {
+  Reader* r = static_cast<Reader*>(handle);
+  return r && r->fp ? std::ftell(r->fp) : -1;
+}
+
+void MXTRecordIOReaderFree(void* handle) {
+  Reader* r = static_cast<Reader*>(handle);
+  if (r) {
+    if (r->fp) std::fclose(r->fp);
+    delete r;
+  }
+}
+
+}  // extern "C"

@@ -1,9 +1,16 @@
-"""Test configuration: run the whole suite on a virtual 8-device CPU mesh.
+"""Test configuration: virtual 8-device CPU mesh + optional real-TPU lane.
 
 Mirrors the reference's test strategy (SURVEY.md §4): real stack, local
 devices, exact-arithmetic assertions — multi-chip behavior is validated on
 host-platform virtual devices the way the reference validates distributed
 kvstore with all workers on localhost.
+
+The CPU platform stays the DEFAULT backend (fast, deterministic, 8
+devices), but the real accelerator — when one is attached — is registered
+as a secondary platform so ``tests/test_tpu_real.py`` can target it via
+``mx.context.tpu()``, the analog of the reference's gpu lane
+(``tests/python/gpu/test_operator_gpu.py``).  Set ``MXNET_TPU_TESTS=0``
+to force a pure-CPU run.
 """
 import os
 
@@ -16,4 +23,13 @@ if "xla_force_host_platform_device_count" not in _flags:
 # config knob must be set programmatically before the backend initializes.
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+if os.environ.get("MXNET_TPU_TESTS", "1") != "0":
+    # cpu first = cpu default; accelerator reachable via jax.devices("axon")
+    jax.config.update("jax_platforms", "cpu,axon")
+    try:
+        jax.devices()
+    except RuntimeError:
+        # axon plugin present but no chip behind it — fall back to pure cpu
+        jax.config.update("jax_platforms", "cpu")
+else:
+    jax.config.update("jax_platforms", "cpu")

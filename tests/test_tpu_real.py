@@ -1,0 +1,123 @@
+"""Real-accelerator lane: op/executor/training checks on the physical chip.
+
+The analog of the reference's GPU lane (`tests/python/gpu/
+test_operator_gpu.py:1-182` `check_consistency`: run the same graph on two
+device types and compare) plus a train-to-threshold gate like
+`tests/python/train/test_mlp.py` — but against the attached TPU.  The CPU
+platform remains the process default (see conftest); everything here pins
+``mx.context.tpu()`` explicitly.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+from mxnet_tpu.context import _accel_platform
+
+pytestmark = pytest.mark.skipif(
+    _accel_platform() is None, reason="no accelerator attached")
+
+
+def _bind_run(net, ctx, feeds, grad=True, seed=7):
+    """simple_bind on ctx, fill args deterministically, fwd(+bwd)."""
+    shapes = {k: v.shape for k, v in feeds.items()}
+    ex = net.simple_bind(ctx=ctx, **shapes)
+    rng = np.random.RandomState(seed)
+    for name, arr in ex.arg_dict.items():
+        if name in feeds:
+            arr[:] = feeds[name]
+        else:
+            arr[:] = rng.uniform(-0.3, 0.3, arr.shape).astype(np.float32)
+    ex.forward(is_train=grad)
+    outs = [o.asnumpy() for o in ex.outputs]
+    grads = {}
+    if grad:
+        ex.backward()
+        grads = {k: v.asnumpy() for k, v in ex.grad_dict.items()
+                 if v is not None and k not in feeds}
+    return outs, grads
+
+
+def check_consistency(net, feeds, rtol=2e-3, atol=2e-3):
+    """Same symbol, same inputs, cpu vs tpu — outputs and grads must agree."""
+    outs_c, grads_c = _bind_run(net, mx.context.cpu(), feeds)
+    outs_t, grads_t = _bind_run(net, mx.context.tpu(), feeds)
+    for a, b in zip(outs_c, outs_t):
+        np.testing.assert_allclose(a, b, rtol=rtol, atol=atol)
+    for k in grads_c:
+        np.testing.assert_allclose(grads_c[k], grads_t[k], rtol=rtol,
+                                   atol=atol, err_msg=k)
+
+
+def test_ndarray_ops_on_tpu():
+    ctx = mx.context.tpu()
+    a = mx.nd.array(np.arange(12, dtype=np.float32).reshape(3, 4), ctx=ctx)
+    b = mx.nd.array(np.ones((3, 4), np.float32), ctx=ctx)
+    c = (a + b) * 2 - a / (b + 1)
+    expect = (np.arange(12, dtype=np.float32).reshape(3, 4) + 1) * 2 \
+        - np.arange(12, dtype=np.float32).reshape(3, 4) / 2
+    np.testing.assert_allclose(c.asnumpy(), expect, rtol=1e-6)
+    assert "TPU" in str(c.data.device) or c.data.device.platform != "cpu"
+
+
+def test_mlp_consistency_cpu_tpu():
+    net = sym.FullyConnected(data=sym.Variable("data"), num_hidden=16,
+                             name="fc1")
+    net = sym.Activation(data=net, act_type="relu")
+    net = sym.FullyConnected(data=net, num_hidden=4, name="fc2")
+    net = sym.SoftmaxOutput(data=net, name="softmax")
+    rng = np.random.RandomState(0)
+    feeds = {"data": rng.rand(8, 10).astype(np.float32),
+             "softmax_label": rng.randint(0, 4, (8,)).astype(np.float32)}
+    check_consistency(net, feeds)
+
+
+def test_convnet_consistency_cpu_tpu():
+    net = sym.Convolution(data=sym.Variable("data"), kernel=(3, 3),
+                          num_filter=8, pad=(1, 1), name="conv")
+    net = sym.BatchNorm(data=net, name="bn")
+    net = sym.Activation(data=net, act_type="relu")
+    net = sym.Pooling(data=net, kernel=(2, 2), stride=(2, 2),
+                      pool_type="max")
+    net = sym.Flatten(data=net)
+    net = sym.FullyConnected(data=net, num_hidden=4, name="fc")
+    net = sym.LinearRegressionOutput(data=net, name="lro")
+    rng = np.random.RandomState(1)
+    feeds = {"data": rng.rand(4, 3, 8, 8).astype(np.float32),
+             "lro_label": rng.rand(4, 4).astype(np.float32)}
+    # TPU convs run bf16-pass matmuls by default — allow ~1% drift
+    check_consistency(net, feeds, rtol=3e-2, atol=3e-2)
+
+
+def test_bf16_matmul_on_tpu():
+    """bfloat16 FullyConnected runs on the MXU and stays close to f32."""
+    import jax.numpy as jnp
+    ctx = mx.context.tpu()
+    rng = np.random.RandomState(2)
+    a = rng.rand(32, 64).astype(np.float32)
+    w = rng.rand(16, 64).astype(np.float32)
+    x = mx.nd.array(a, ctx=ctx, dtype=jnp.bfloat16)
+    wt = mx.nd.array(w, ctx=ctx, dtype=jnp.bfloat16)
+    out = mx.nd.dot(x, mx.nd.transpose(wt)).asnumpy().astype(np.float32)
+    np.testing.assert_allclose(out, a @ w.T, rtol=2e-2, atol=2e-1)
+
+
+def test_train_to_threshold_on_tpu():
+    """Convergence gate on the chip (reference tests/python/train/test_mlp.py)."""
+    rng = np.random.RandomState(5)
+    centers = rng.randn(4, 10).astype(np.float32) * 3
+    yi = rng.randint(0, 4, 400)
+    X = (centers[yi] + rng.randn(400, 10)).astype(np.float32)
+    y = yi.astype(np.float32)
+    net = sym.FullyConnected(data=sym.Variable("data"), num_hidden=32,
+                             name="fc1")
+    net = sym.Activation(data=net, act_type="relu")
+    net = sym.FullyConnected(data=net, num_hidden=4, name="fc2")
+    net = sym.SoftmaxOutput(data=net, name="softmax")
+    model = mx.FeedForward(net, ctx=mx.context.tpu(), num_epoch=10,
+                           optimizer="sgd", learning_rate=0.1,
+                           numpy_batch_size=50,
+                           initializer=mx.initializer.Xavier())
+    model.fit(X=X, y=y, kvstore=None)
+    acc = model.score(mx.io.NDArrayIter(X, y, batch_size=50))
+    assert acc > 0.9, f"TPU training accuracy {acc} below gate"

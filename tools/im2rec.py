@@ -1,0 +1,88 @@
+#!/usr/bin/env python
+"""Pack images into a RecordIO dataset (.rec + .idx).
+
+TPU-native rebuild of the reference packing tool (``tools/im2rec.cc`` /
+``make_list.py``): consumes a ``.lst`` file (``index\tlabel[\t...]\tpath``
+per line) or an image directory tree (subdir name = class), re-encodes to
+JPEG and writes ``prefix.rec`` + ``prefix.idx`` usable by
+``mxnet_tpu.image_io.ImageRecordIter`` with ``num_parts``/``part_index``
+sharding.
+"""
+import argparse
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def make_list(root):
+    """Walk root; yield (index, label, relpath) with subdir name as class."""
+    classes = sorted(d for d in os.listdir(root)
+                     if os.path.isdir(os.path.join(root, d)))
+    items = []
+    idx = 0
+    for label, cls in enumerate(classes):
+        for fn in sorted(os.listdir(os.path.join(root, cls))):
+            if fn.lower().endswith((".jpg", ".jpeg", ".png", ".bmp")):
+                items.append((idx, float(label), os.path.join(cls, fn)))
+                idx += 1
+    return items
+
+
+def read_list(path):
+    items = []
+    with open(path) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            idx = int(float(parts[0]))
+            labels = [float(x) for x in parts[1:-1]]
+            items.append((idx, labels[0] if len(labels) == 1 else labels,
+                          parts[-1]))
+    return items
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("prefix", help="output prefix (writes prefix.rec/.idx)")
+    ap.add_argument("root", help="image root directory")
+    ap.add_argument("--lst", help=".lst file; default: scan root")
+    ap.add_argument("--resize", type=int, default=0,
+                    help="resize short side before packing")
+    ap.add_argument("--quality", type=int, default=95)
+    ap.add_argument("--shuffle", action="store_true")
+    args = ap.parse_args()
+
+    import cv2
+    from mxnet_tpu import recordio
+
+    items = read_list(args.lst) if args.lst else make_list(args.root)
+    if args.shuffle:
+        random.shuffle(items)
+    writer = recordio.MXIndexedRecordIO(args.prefix + ".idx",
+                                        args.prefix + ".rec", "w")
+    n = 0
+    for idx, label, relpath in items:
+        img = cv2.imread(os.path.join(args.root, relpath))
+        if img is None:
+            print(f"skip unreadable {relpath}", file=sys.stderr)
+            continue
+        if args.resize > 0:
+            h, w = img.shape[:2]
+            if h < w:
+                size = (max(1, int(w * args.resize / h)), args.resize)
+            else:
+                size = (args.resize, max(1, int(h * args.resize / w)))
+            img = cv2.resize(img, size)
+        header = recordio.IRHeader(0, label, idx, 0)
+        writer.write_idx(idx, recordio.pack_img(header, img,
+                                                quality=args.quality))
+        n += 1
+    writer.close()
+    print(f"packed {n} images -> {args.prefix}.rec")
+
+
+if __name__ == "__main__":
+    main()
