@@ -50,6 +50,13 @@ from . import model
 from .model import FeedForward, save_checkpoint, load_checkpoint
 from . import module as mod
 from . import module
+from . import operator
+from . import operator as opr
+from . import monitor
+from .monitor import Monitor
+from . import rtc
+from . import visualization
+from . import visualization as viz
 
 __version__ = "0.1.0"
 

@@ -40,6 +40,27 @@ def _accel_platform() -> Optional[str]:
     return None
 
 
+@functools.lru_cache(maxsize=None)
+def _platform_supports_callbacks(platform: str) -> bool:
+    """Probe whether a backend can run jax host callbacks (pure_callback).
+
+    Some TPU plugins (e.g. the axon tunnel) reject host send/recv; custom
+    Python ops must then run their bodies against cpu-committed values.
+    """
+    if platform == "cpu":
+        return True
+    import numpy as _np
+    try:
+        dev = jax.devices(platform)[0]
+        x = jax.device_put(_np.zeros((1,), _np.float32), dev)
+        jax.pure_callback(lambda v: _np.asarray(v),
+                          jax.ShapeDtypeStruct((1,), _np.float32),
+                          x).block_until_ready()
+        return True
+    except Exception:
+        return False
+
+
 class Context:
     """Device context.
 

@@ -122,6 +122,59 @@ class Executor:
         self._topo = symbol._topo()
         self._node_index = {id(n): i for i, n in enumerate(self._topo)}
 
+        # --- model parallelism: ctx_group -> device placement -------------
+        # (reference AssignContext, graph_executor.cc:390+; dead-kwarg no
+        # more).  Ops carrying a __ctx_group__ attr run on group2ctx[group];
+        # variables are placed with their first consumer; execution goes
+        # eager (per-op async dispatch ≈ the reference engine) with
+        # transfers at group boundaries.
+        self._placement: Optional[Dict[str, jax.Device]] = None
+        if self._group2ctx:
+            placement: Dict[str, jax.Device] = {}
+            default_dev = ctx.jax_device
+            for node in self._topo:
+                if node.is_variable:
+                    continue
+                group = node.anno_attrs().get("ctx_group")
+                gctx = self._group2ctx.get(group) if group else None
+                placement[node.name] = (Context(gctx).jax_device if gctx
+                                        else default_dev)
+            # variables adopt the first consumer's device
+            var_dev: Dict[str, jax.Device] = {}
+            for node in self._topo:
+                if node.is_variable:
+                    continue
+                for src, _ in node.inputs:
+                    if src.is_variable and src.name not in var_dev:
+                        var_dev[src.name] = placement[node.name]
+            self._placement = placement
+            for name_, arr in self._arg_dict.items():
+                dev = var_dev.get(name_)
+                if dev is not None:
+                    arr._migrate(dev)
+            for name_, arr in self._grad_dict.items():
+                dev = var_dev.get(name_)
+                if dev is not None:
+                    arr._migrate(dev)
+
+        # --- custom-op host callbacks on callback-less backends -----------
+        # Python-bodied ops run under jax.pure_callback; backends that
+        # reject host send/recv (axon tunnel) get the op pinned to cpu with
+        # transfers at the boundary — the reference's NumpyOp is the same
+        # sync-through-host design (native_op-inl.h).
+        from .context import _platform_supports_callbacks
+        cb_nodes = [n for n in self._topo
+                    if not n.is_variable and n.op.name in ("Custom",
+                                                           "_PythonOp")]
+        if cb_nodes and not _platform_supports_callbacks(
+                ctx.jax_device.platform):
+            if self._placement is None:
+                self._placement = {n.name: ctx.jax_device
+                                   for n in self._topo if not n.is_variable}
+            cpu_dev = jax.devices("cpu")[0]
+            for n in cb_nodes:
+                self._placement[n.name] = cpu_dev
+
     # ------------------------------------------------------------------
     # Graph evaluation (traced under jit)
     # ------------------------------------------------------------------
@@ -130,7 +183,8 @@ class Executor:
               rng, is_train: bool, want_internals: bool = False):
         from .graph_eval import eval_symbol
         return eval_symbol(self._symbol, arg_vals, aux_vals, rng, is_train,
-                           want_internals=want_internals, topo=self._topo)
+                           want_internals=want_internals, topo=self._topo,
+                           placement=self._placement)
 
     # compiled program builders ----------------------------------------
 
@@ -142,7 +196,10 @@ class Executor:
         full_key = (id(self._symbol), key)
         ent = self._cache.get(full_key)
         if ent is None or ent[0] is not self._symbol:
-            ent = (self._symbol, jax.jit(build()))
+            fn = build()
+            # group-placed graphs run eagerly: per-op async dispatch with
+            # cross-device transfers, like the reference engine schedule
+            ent = (self._symbol, fn if self._placement else jax.jit(fn))
             self._cache[full_key] = ent
         return ent[1]
 

@@ -24,7 +24,7 @@ __all__ = ["eval_symbol"]
 
 def eval_symbol(symbol, arg_vals: Dict[str, jax.Array],
                 aux_vals: Dict[str, jax.Array], rng, is_train: bool,
-                want_internals: bool = False, topo=None):
+                want_internals: bool = False, topo=None, placement=None):
     """Evaluate a Symbol graph on jax values.
 
     Parameters
@@ -45,6 +45,12 @@ def eval_symbol(symbol, arg_vals: Dict[str, jax.Array],
         (the monitor-hook path, reference ``graph_executor.cc:890-905``).
     topo : list of nodes, optional
         Pre-computed ``symbol._topo()`` to skip re-sorting in hot paths.
+    placement : dict node-name -> jax.Device, optional
+        Model-parallel device placement (``ctx_group``/``group2ctx``,
+        reference ``graph_executor.cc:390+``): each node's inputs are
+        transferred to its device before execution — the analog of the
+        auto-inserted ``_CrossDeviceCopy`` nodes.  Only valid in eager
+        (non-jit) evaluation.
 
     Returns ``(heads, aux_updates)`` or ``(heads, aux_updates, internals)``.
     """
@@ -62,6 +68,11 @@ def eval_symbol(symbol, arg_vals: Dict[str, jax.Array],
         op = node.op
         params = node.parsed_params()
         in_vals = [vals[(id(s), i)] for (s, i) in node.inputs]
+        if placement is not None and node.name in placement:
+            # no-op for values already on the device; under jax.vjp tracing
+            # it records a transfer primitive
+            dev = placement[node.name]
+            in_vals = [jax.device_put(v, dev) for v in in_vals]
         aux_full = node.aux_full_names()
         short = op.list_aux_states(params)
         aux = {sh: aux_vals[f] for sh, f in zip(short, aux_full)}

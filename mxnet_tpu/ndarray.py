@@ -141,6 +141,12 @@ class NDArray:
     # Mutation
     # ------------------------------------------------------------------
 
+    def _migrate(self, device) -> None:
+        """Move the backing storage to another device (model-parallel
+        placement at bind; the one sanctioned way to change a chunk's
+        home)."""
+        self._chunk.write(jax.device_put(self._chunk.data, device))
+
     def _write(self, value: jax.Array) -> None:
         """Write `value` (shaped like this array/view) through to the chunk."""
         if not self.writable:
@@ -393,8 +399,18 @@ def concatenate(arrays: Sequence[NDArray], axis: int = 0) -> NDArray:
 
 
 def waitall() -> None:
-    """Engine::WaitForAll analog — effectively a no-op barrier helper."""
-    (jax.device_put(0.0) + 0).block_until_ready()
+    """``Engine::WaitForAll`` analog: block until every outstanding
+    async computation has finished, by syncing all live device arrays
+    (the dispatched-work set the reference engine tracks via vars)."""
+    for arr in jax.live_arrays():
+        try:
+            arr.block_until_ready()
+        except RuntimeError as e:
+            # deleted/donated buffers are "complete"; real async failures
+            # must surface at this sync point
+            if "deleted" in str(e) or "donated" in str(e):
+                continue
+            raise
 
 
 # ---------------------------------------------------------------------------

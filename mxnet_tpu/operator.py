@@ -1,0 +1,383 @@
+"""Custom operators defined in Python — all three reference generations.
+
+Parity target: reference ``python/mxnet/operator.py`` —
+``PythonOp:15``/``NumpyOp:122`` (sync numpy bodies, ``_Native`` bridge),
+``NDArrayOp:222`` (NDArray bodies, ``_NDArray`` bridge, ``custom-inl.h``),
+``CustomOp:392`` + ``CustomOpProp:438`` + ``register:550`` (the modern
+``Custom`` op, ``src/operator/custom-inl.h:30-62``).
+
+TPU-native realization: the host-side body runs under
+``jax.pure_callback`` (the XLA host-callback analog of the reference's
+callback blobs marshalled through ``MXCallbackList``), wrapped in
+``jax.custom_vjp`` so the user's ``backward`` supplies the gradient.  The
+custom op therefore composes with jit/vjp like any native op while its
+body executes in Python on the host.
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+from .ops.registry import OpDef, OpParam, register_op
+
+__all__ = ["PythonOp", "NumpyOp", "NDArrayOp", "CustomOp", "CustomOpProp",
+           "register", "get_all_registered_operators"]
+
+
+# ---------------------------------------------------------------------------
+# host-callback bridge shared by all generations
+# ---------------------------------------------------------------------------
+
+def _callback_apply(fwd_cb, bwd_cb, in_vals, out_shapes, out_dtypes,
+                    in_shapes, in_dtypes):
+    """Run a host-Python op body under pure_callback with a custom VJP.
+
+    ``fwd_cb(*np_inputs) -> tuple of np outputs``
+    ``bwd_cb(*(np_out_grads + np_inputs + np_outputs)) -> np in_grads``
+    """
+    out_struct = tuple(jax.ShapeDtypeStruct(s, d)
+                       for s, d in zip(out_shapes, out_dtypes))
+    in_struct = tuple(jax.ShapeDtypeStruct(s, d)
+                      for s, d in zip(in_shapes, in_dtypes))
+
+    @jax.custom_vjp
+    def run(*ins):
+        return jax.pure_callback(fwd_cb, out_struct, *ins)
+
+    def fwd(*ins):
+        outs = jax.pure_callback(fwd_cb, out_struct, *ins)
+        return outs, (ins, outs)
+
+    def bwd(res, gs):
+        ins, outs = res
+        grads = jax.pure_callback(bwd_cb, in_struct, *gs, *ins, *outs)
+        return tuple(grads)
+
+    run.defvjp(fwd, bwd)
+    return run(*in_vals)
+
+
+# ---------------------------------------------------------------------------
+# Generation 1/2: PythonOp -> NumpyOp / NDArrayOp
+# ---------------------------------------------------------------------------
+
+_INSTANCES: Dict[str, "PythonOp"] = {}
+_instance_counter = itertools.count()
+
+
+class PythonOp:
+    """Base class for instance-style custom ops (reference ``operator.py:15``).
+
+    Subclass and override ``forward``/``backward``/``infer_shape``/
+    ``list_arguments``/``list_outputs``; call :meth:`get_symbol` to use the
+    op in a Symbol graph.
+    """
+
+    def __init__(self, need_top_grad: bool = True):
+        self.need_top_grad_ = need_top_grad
+
+    # -- metadata -------------------------------------------------------
+    def list_arguments(self) -> List[str]:
+        return ["data"]
+
+    def list_outputs(self) -> List[str]:
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        """Default: single output shaped like the first input."""
+        return in_shape, [in_shape[0]]
+
+    def need_top_grad(self) -> bool:
+        """Whether backward needs the head gradient (False for losses)."""
+        return self.need_top_grad_
+
+    # -- body (user hooks) ---------------------------------------------
+    def forward(self, in_data, out_data):
+        raise NotImplementedError
+
+    def backward(self, out_grad, in_data, out_data, in_grad):
+        raise NotImplementedError
+
+    # -- symbol construction -------------------------------------------
+    def get_symbol(self, *args, **kwargs):
+        from . import symbol as sym_mod
+        name = kwargs.pop("name", None)
+        key = f"_pyop_{next(_instance_counter)}"
+        _INSTANCES[key] = self
+        return sym_mod._apply_op("_PythonOp", list(args),
+                                 {"op_instance_key": key}, name, kwargs)
+
+    # internal: numpy-vs-NDArray calling convention
+    _numpy_style = True
+
+
+class NumpyOp(PythonOp):
+    """Custom op whose body sees numpy arrays (reference ``NumpyOp:122``).
+
+    ``forward(in_data, out_data)`` / ``backward(out_grad, in_data,
+    out_data, in_grad)`` mutate the ``out_data``/``in_grad`` arrays in
+    place, exactly like the reference calling convention.
+    """
+
+    _numpy_style = True
+
+
+class NDArrayOp(PythonOp):
+    """Custom op whose body sees NDArrays (reference ``NDArrayOp:222``).
+
+    Same in-place convention; arrays arrive as writable
+    :class:`~mxnet_tpu.ndarray.NDArray` host views.
+    """
+
+    _numpy_style = False
+
+
+def _wrap_arrays(numpy_style, arrays):
+    if numpy_style:
+        return list(arrays)
+    from .ndarray import array as nd_array
+    return [nd_array(a) for a in arrays]
+
+
+def _unwrap_array(numpy_style, a):
+    return np.asarray(a) if numpy_style else a.asnumpy()
+
+
+def _pyop_forward(ctx, params, *in_vals):
+    op = _INSTANCES[params["op_instance_key"]]
+    in_shapes = [tuple(v.shape) for v in in_vals]
+    in_dtypes = [v.dtype for v in in_vals]
+    _, out_shapes = op.infer_shape([list(s) for s in in_shapes])
+    out_shapes = [tuple(s) for s in out_shapes]
+    out_dtypes = [in_dtypes[0] if in_dtypes else np.float32] * len(out_shapes)
+    ns = op._numpy_style
+
+    def fwd_cb(*ins):
+        ins = [np.asarray(x) for x in ins]
+        outs = [np.zeros(s, d) for s, d in zip(out_shapes, out_dtypes)]
+        out_w = _wrap_arrays(ns, outs)  # user mutates these in place
+        op.forward(in_data=_wrap_arrays(ns, ins), out_data=out_w)
+        return tuple(_unwrap_array(ns, o) for o in out_w)
+
+    def bwd_cb(*flat):
+        n_out, n_in = len(out_shapes), len(in_shapes)
+        gs = [np.asarray(x) for x in flat[:n_out]]
+        ins = [np.asarray(x) for x in flat[n_out:n_out + n_in]]
+        outs = [np.asarray(x) for x in flat[n_out + n_in:]]
+        in_grads = [np.zeros(s, d) for s, d in zip(in_shapes, in_dtypes)]
+        grad_w = _wrap_arrays(ns, in_grads)
+        out_grad = gs if op.need_top_grad() else []
+        op.backward(out_grad=_wrap_arrays(ns, out_grad),
+                    in_data=_wrap_arrays(ns, ins),
+                    out_data=_wrap_arrays(ns, outs),
+                    in_grad=grad_w)
+        return tuple(_unwrap_array(ns, g).astype(d) for g, d in
+                     zip(grad_w, in_dtypes))
+
+    out = _callback_apply(fwd_cb, bwd_cb, in_vals, out_shapes, out_dtypes,
+                          in_shapes, in_dtypes)
+    return out if len(out) > 1 else out[0]
+
+
+def _pyop_args(params):
+    return _INSTANCES[params["op_instance_key"]].list_arguments()
+
+
+def _pyop_outputs(params):
+    return _INSTANCES[params["op_instance_key"]].list_outputs()
+
+
+def _pyop_infer_shape(params, in_shapes):
+    op = _INSTANCES[params["op_instance_key"]]
+    if all(s is None for s in in_shapes):
+        return in_shapes, [None] * len(op.list_outputs()), []
+    # partial shapes pass through as None for the user hook to complete,
+    # like the reference's empty-TShape convention
+    ins, outs = op.infer_shape([list(s) if s is not None else None
+                                for s in in_shapes])
+    return ([tuple(s) if s is not None else None for s in ins],
+            [tuple(s) if s is not None else None for s in outs], [])
+
+
+register_op(OpDef(
+    name="_PythonOp",
+    forward=_pyop_forward,
+    arguments=_pyop_args,
+    outputs=_pyop_outputs,
+    params={"op_instance_key": OpParam("op_instance_key", "str",
+                                       required=True)},
+    infer_shape=_pyop_infer_shape,
+    doc="Instance-bound Python custom op (reference _Native/_NDArray "
+        "bridges, native_op-inl.h / ndarray_op-inl.h).",
+))
+
+
+# ---------------------------------------------------------------------------
+# Generation 3: CustomOp / CustomOpProp / register
+# ---------------------------------------------------------------------------
+
+_CUSTOM_PROPS: Dict[str, type] = {}
+
+
+class CustomOp:
+    """Stateful custom operator body (reference ``CustomOp:392``)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError
+
+    def assign(self, dst, req, src):
+        """Honor the grad_req write/add/null protocol."""
+        if req in ("null", None):
+            return
+        if req in ("write", "inplace"):
+            dst[:] = src
+        elif req == "add":
+            dst[:] = dst + src
+        else:
+            raise MXNetError(f"unknown req {req!r}")
+
+
+class CustomOpProp:
+    """Metadata + factory for a registered custom op (reference
+    ``CustomOpProp:438``)."""
+
+    def __init__(self, need_top_grad: bool = True):
+        self.need_top_grad_ = need_top_grad
+
+    def list_arguments(self) -> List[str]:
+        return ["data"]
+
+    def list_outputs(self) -> List[str]:
+        return ["output"]
+
+    def list_auxiliary_states(self) -> List[str]:
+        return []
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def need_top_grad(self) -> bool:
+        return self.need_top_grad_
+
+    def create_operator(self, ctx, in_shapes, in_dtypes) -> CustomOp:
+        raise NotImplementedError
+
+
+def register(reg_name: str):
+    """Class decorator registering a CustomOpProp under ``op_type``
+    (reference ``operator.py:550``)."""
+    def do_register(prop_cls):
+        if not issubclass(prop_cls, CustomOpProp):
+            raise MXNetError("register() expects a CustomOpProp subclass")
+        _CUSTOM_PROPS[reg_name] = prop_cls
+        return prop_cls
+    return do_register
+
+
+def get_all_registered_operators() -> List[str]:
+    return sorted(_CUSTOM_PROPS)
+
+
+def _make_prop(params: Dict[str, Any]) -> CustomOpProp:
+    op_type = params["op_type"]
+    if op_type not in _CUSTOM_PROPS:
+        raise MXNetError(
+            f"custom op {op_type!r} not registered; known: "
+            f"{get_all_registered_operators()}")
+    kwargs = {k: v for k, v in params.items()
+              if k != "op_type" and v is not None}
+    return _CUSTOM_PROPS[op_type](**kwargs)
+
+
+class _CustomOpDef(OpDef):
+    """OpDef whose free-form params are forwarded to the prop constructor
+    as strings (the reference passes all Custom kwargs through the C
+    boundary as char** pairs)."""
+
+    def parse_params(self, raw: Dict[str, Any]) -> Dict[str, Any]:
+        if "op_type" not in raw:
+            raise MXNetError("Custom requires op_type=")
+        out = {k: str(v) for k, v in raw.items()
+               if not (k.startswith("__") and k.endswith("__"))}
+        return out
+
+
+def _custom_forward(ctx, params, *in_vals):
+    prop = _make_prop(params)
+    in_shapes = [tuple(v.shape) for v in in_vals]
+    in_dtypes = [v.dtype for v in in_vals]
+    _, out_shapes, _ = prop.infer_shape([list(s) for s in in_shapes])
+    out_shapes = [tuple(s) for s in out_shapes]
+    out_dtypes = [in_dtypes[0] if in_dtypes else np.float32] * len(out_shapes)
+    body = prop.create_operator(None, in_shapes, in_dtypes)
+    is_train = ctx.is_train
+    n_out, n_in = len(out_shapes), len(in_shapes)
+
+    def fwd_cb(*ins):
+        ins = [np.asarray(x).copy() for x in ins]
+        outs = [np.zeros(s, d) for s, d in zip(out_shapes, out_dtypes)]
+        body.forward(is_train=is_train, req=["write"] * n_out,
+                     in_data=ins, out_data=outs, aux=[])
+        return tuple(outs)
+
+    def bwd_cb(*flat):
+        gs = [np.asarray(x) for x in flat[:n_out]]
+        ins = [np.asarray(x).copy() for x in flat[n_out:n_out + n_in]]
+        outs = [np.asarray(x).copy() for x in flat[n_out + n_in:]]
+        in_grads = [np.zeros(s, d) for s, d in zip(in_shapes, in_dtypes)]
+        body.backward(req=["write"] * n_in, out_grad=gs,
+                      in_data=ins, out_data=outs, in_grad=in_grads, aux=[])
+        return tuple(in_grads)
+
+    out = _callback_apply(fwd_cb, bwd_cb, in_vals, out_shapes, out_dtypes,
+                          in_shapes, in_dtypes)
+    return out if len(out) > 1 else out[0]
+
+
+def _custom_args(params):
+    return _make_prop(params).list_arguments()
+
+
+def _custom_outputs(params):
+    return _make_prop(params).list_outputs()
+
+
+def _custom_infer_shape(params, in_shapes):
+    prop = _make_prop(params)
+    if all(s is None for s in in_shapes):
+        return in_shapes, [None] * len(prop.list_outputs()), []
+    ins, outs, aux = prop.infer_shape([list(s) if s is not None else None
+                                       for s in in_shapes])
+    return ([tuple(s) if s is not None else None for s in ins],
+            [tuple(s) if s is not None else None for s in outs],
+            [tuple(s) if s is not None else None for s in aux])
+
+
+register_op(_CustomOpDef(
+    name="Custom",
+    forward=_custom_forward,
+    arguments=_custom_args,
+    outputs=_custom_outputs,
+    params={"op_type": OpParam("op_type", "str", required=True)},
+    infer_shape=_custom_infer_shape,
+    doc="Registered Python custom op (reference custom-inl.h:30-62, "
+        "operator.py:392-550).",
+))
+
+
+# expose Custom through the generated symbol/ndarray constructors
+def _refresh_generated_modules():
+    from . import symbol as sym_mod
+    sym_mod._init_symbol_module()
+
+
+_refresh_generated_modules()

@@ -783,13 +783,82 @@ register_op(OpDef(
 # Softmax family (src/operator/{softmax_output,softmax_activation}-inl.h)
 # ---------------------------------------------------------------------------
 
+def _softmax_row_block(n, c, itemsize):
+    """Pick a VMEM-bounded row-block size for the fused softmax kernel.
+
+    Mosaic needs the sublane (row) block divisible by 8 or equal to n,
+    and the in+out blocks should stay well inside the ~16MB/core VMEM
+    budget (~2MB each).  Returns None when no legal block exists — the
+    caller then uses the XLA softmax.
+    """
+    rows_cap = (2 * 1024 * 1024) // max(1, c * itemsize)
+    if rows_cap < 1:
+        return None
+    if n <= rows_cap:
+        return n  # whole array in one block (equal-to-dim is always legal)
+    for block in range(rows_cap // 8 * 8, 0, -8):
+        if n % block == 0:
+            return block
+    return None
+
+
+def _pallas_softmax_rows(x, block=None):
+    """Fused row-softmax Pallas kernel (one VMEM pass: max, exp, sum,
+    divide) — the MXRtc-analog bespoke kernel for the hottest head op.
+    Grid over row blocks so large batches stream through VMEM."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n, c = x.shape
+    if block is None:
+        block = _softmax_row_block(n, c, x.dtype.itemsize)
+        if block is None:
+            return jax.nn.softmax(x, axis=-1)
+
+    def body(x_ref, o_ref):
+        v = x_ref[:]
+        m = jnp.max(v, axis=-1, keepdims=True)
+        e = jnp.exp(v - m)
+        o_ref[:] = e / jnp.sum(e, axis=-1, keepdims=True)
+
+    # Mosaic rejects i64 index types, so trace the kernel with x64 off
+    # (the package enables jax_enable_x64 globally)
+    with jax.enable_x64(False):
+        return pl.pallas_call(
+            body,
+            out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+            grid=(n // block,),
+            in_specs=[pl.BlockSpec((block, c), lambda i: (i, 0),
+                                   memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec((block, c), lambda i: (i, 0),
+                                   memory_space=pltpu.VMEM),
+        )(x)
+
+
+def _softmax_rows(x):
+    """Row softmax: Pallas kernel on accelerator backends, jnp on cpu.
+
+    ``platform_dependent`` resolves the branch at lowering time, so one
+    traced graph works for both the cpu test mesh and the real chip."""
+    if x.ndim != 2 or x.shape[-1] > 16384 or x.dtype not in (
+            jnp.float32, jnp.bfloat16):
+        return jax.nn.softmax(x, axis=-1)
+    block = _softmax_row_block(x.shape[0], x.shape[1], x.dtype.itemsize)
+    if block is None:
+        return jax.nn.softmax(x, axis=-1)
+    return jax.lax.platform_dependent(
+        x,
+        cpu=lambda v: jax.nn.softmax(v, axis=-1),
+        default=lambda v: _pallas_softmax_rows(v, block=block))
+
+
 def _softmax_output_core(data, label, grad_scale, ignore_label, multi_output,
                          use_ignore, normalization):
     @jax.custom_vjp
     def _fn(data, label):
         if multi_output and data.ndim > 2:
             return jax.nn.softmax(data, axis=1)
-        return jax.nn.softmax(data, axis=-1)
+        return _softmax_rows(data)
 
     def _fwd(data, label):
         return _fn(data, label), (data, label)

@@ -1,0 +1,72 @@
+"""Monitor + visualization tests (reference monitor.py:13-120,
+visualization.py print_summary/plot_network)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+from mxnet_tpu import models
+
+
+def _mlp():
+    net = sym.FullyConnected(data=sym.Variable("data"), num_hidden=8,
+                             name="fc1")
+    net = sym.Activation(data=net, act_type="relu", name="relu1")
+    net = sym.FullyConnected(data=net, num_hidden=3, name="fc2")
+    return sym.SoftmaxOutput(data=net, name="softmax")
+
+
+def test_monitor_collects_stats():
+    net = _mlp()
+    ex = net.simple_bind(ctx=mx.cpu(), data=(4, 6), softmax_label=(4,))
+    mon = mx.Monitor(interval=1, pattern=".*fc.*")
+    mon.install(ex)
+    rng = np.random.RandomState(0)
+    for n, a in ex.arg_dict.items():
+        a[:] = rng.rand(*a.shape)
+    mon.tic()
+    ex.forward(is_train=True)
+    rows = mon.toc()
+    names = [k for _, k, _ in rows]
+    # node outputs matching the pattern plus fc weights/biases
+    assert any("fc1" in n for n in names)
+    assert "fc1_weight" in names and "fc2_weight" in names
+    for _, _, stat in rows:
+        assert float(stat) >= 0.0
+
+
+def test_monitor_interval_and_fit():
+    """fit(monitor=...) exercises the tic/toc_print path end to end."""
+    rng = np.random.RandomState(0)
+    X = rng.rand(60, 6).astype(np.float32)
+    y = rng.randint(0, 3, 60).astype(np.float32)
+    mon = mx.Monitor(interval=2)
+    model = mx.FeedForward(_mlp(), ctx=mx.cpu(), num_epoch=2,
+                           optimizer="sgd", learning_rate=0.1,
+                           numpy_batch_size=20)
+    model.fit(X=X, y=y, kvstore=None, monitor=mon)
+    assert mon.step > 0
+
+
+def test_print_summary(capsys):
+    net = models.get_symbol("mlp")
+    mx.visualization.print_summary(net, shape={"data": (1, 784)})
+    out = capsys.readouterr().out
+    assert "fc1 (FullyConnected)" in out
+    # mlp params: 784*128+128 + 128*64+64 + 64*10+10
+    total = 784 * 128 + 128 + 128 * 64 + 64 + 64 * 10 + 10
+    assert f"Total params: {total}" in out
+
+
+def test_plot_network_optional():
+    net = _mlp()
+    try:
+        import graphviz  # noqa: F401
+    except ImportError:
+        import pytest
+        with pytest.raises(mx.MXNetError):
+            mx.visualization.plot_network(net)
+        return
+    dot = mx.visualization.plot_network(net, shape={"data": (1, 6),
+                                                    "softmax_label": (1,)})
+    src = dot.source
+    assert "fc1" in src and "softmax" in src

@@ -102,6 +102,35 @@ def test_bf16_matmul_on_tpu():
     np.testing.assert_allclose(out, a @ w.T, rtol=2e-2, atol=2e-1)
 
 
+def test_custom_op_on_tpu():
+    """Custom Python op in a TPU-ctx graph: backends without host-callback
+    support must route the op body through cpu transparently."""
+    from mxnet_tpu import operator as opr
+
+    @opr.register("tpu_lane_scale")
+    class ScaleProp(opr.CustomOpProp):
+        def create_operator(self, ctx, in_shapes, in_dtypes):
+            class Scale(opr.CustomOp):
+                def forward(self, is_train, req, in_data, out_data, aux):
+                    self.assign(out_data[0], req[0], in_data[0] * 4.0)
+
+                def backward(self, req, out_grad, in_data, out_data,
+                             in_grad, aux):
+                    self.assign(in_grad[0], req[0], out_grad[0] * 4.0)
+            return Scale()
+
+    net = sym.Custom(data=sym.Variable("data"), op_type="tpu_lane_scale",
+                     name="scale")
+    ex = net.simple_bind(ctx=mx.context.tpu(), data=(2, 3))
+    x = np.arange(6, dtype=np.float32).reshape(2, 3)
+    ex.arg_dict["data"][:] = x
+    ex.forward(is_train=True)
+    np.testing.assert_allclose(ex.outputs[0].asnumpy(), 4 * x)
+    ex.backward([mx.nd.array(np.ones_like(x))])
+    np.testing.assert_allclose(ex.grad_dict["data"].asnumpy(),
+                               np.full((2, 3), 4.0))
+
+
 def test_train_to_threshold_on_tpu():
     """Convergence gate on the chip (reference tests/python/train/test_mlp.py)."""
     rng = np.random.RandomState(5)
