@@ -30,6 +30,9 @@ def main():
 
     ap.add_argument("--warmup", type=_positive, default=10)
     ap.add_argument("--steps", type=_positive, default=50)
+    ap.add_argument("--precision", default="bfloat16",
+                    choices=("bfloat16", "float32", "highest"),
+                    help="MXU matmul precision for the compiled step")
     args = ap.parse_args()
 
     import jax
@@ -44,7 +47,8 @@ def main():
     trainer = ShardedTrainer(
         sym, mesh=mesh, optimizer="sgd",
         optimizer_params={"learning_rate": 0.05, "momentum": 0.9,
-                          "wd": 0.0001})
+                          "wd": 0.0001},
+        matmul_precision=args.precision)
     trainer.bind(data_shapes={"data": (batch,) + image},
                  label_shapes={"softmax_label": (batch,)})
 
@@ -72,6 +76,7 @@ def main():
         "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
         "step_ms": round(1000 * elapsed / args.steps, 2),
         "n_devices": len(jax.devices()),
+        "precision": args.precision,
     }
     print(json.dumps(result))
     return 0

@@ -1,0 +1,161 @@
+"""Bucketed LSTM language model (reference example/rnn/lstm.py +
+bucket_io.py analog).
+
+Builds the LSTM cell from primitive symbols exactly like the 2016
+reference did (FullyConnected i2h/h2h -> SliceChannel into 4 gates),
+unrolls per bucket length, and trains with BucketingModule so each
+bucket's executor shares one compiled-program cache.  Data is synthetic
+variable-length "sentences" over a small vocab (char-LM style).
+"""
+import argparse
+import os
+import sys
+from collections import namedtuple
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+
+LSTMState = namedtuple("LSTMState", ["c", "h"])
+
+
+def lstm_unroll(num_hidden, seq_len, vocab, num_embed):
+    """Unrolled char-LM symbol for one bucket length.
+
+    NOTE on weight sharing across timesteps: the reference shares weights
+    by passing the same Variable into every step; we do the same.
+    """
+    embed_weight = sym.Variable("embed_weight")
+    i2h_weight = sym.Variable("l0_i2h_weight")
+    i2h_bias = sym.Variable("l0_i2h_bias")
+    h2h_weight = sym.Variable("l0_h2h_weight")
+    h2h_bias = sym.Variable("l0_h2h_bias")
+    cls_weight = sym.Variable("cls_weight")
+    cls_bias = sym.Variable("cls_bias")
+    init_c = sym.Variable("l0_init_c")
+    init_h = sym.Variable("l0_init_h")
+
+    data = sym.Variable("data")            # [B, L] token ids
+    embed = sym.Embedding(data=data, input_dim=vocab, output_dim=num_embed,
+                          weight=embed_weight, name="embed")
+    steps = sym.SliceChannel(data=embed, num_outputs=seq_len, axis=1,
+                             squeeze_axis=True, name="step_slice")
+    state = LSTMState(c=init_c, h=init_h)
+    outs = []
+    for t in range(seq_len):
+        i2h = sym.FullyConnected(data=steps[t], num_hidden=num_hidden * 4,
+                                 weight=i2h_weight, bias=i2h_bias,
+                                 name=f"t{t}_i2h")
+        h2h = sym.FullyConnected(data=state.h, num_hidden=num_hidden * 4,
+                                 weight=h2h_weight, bias=h2h_bias,
+                                 name=f"t{t}_h2h")
+        gates = i2h + h2h
+        slices = sym.SliceChannel(data=gates, num_outputs=4,
+                                  name=f"t{t}_slice")
+        in_gate = sym.Activation(data=slices[0], act_type="sigmoid")
+        in_trans = sym.Activation(data=slices[1], act_type="tanh")
+        forget = sym.Activation(data=slices[2], act_type="sigmoid")
+        out_gate = sym.Activation(data=slices[3], act_type="sigmoid")
+        c = (forget * state.c) + (in_gate * in_trans)
+        h = out_gate * sym.Activation(data=c, act_type="tanh")
+        state = LSTMState(c=c, h=h)
+        fc = sym.FullyConnected(data=h, num_hidden=vocab,
+                                weight=cls_weight, bias=cls_bias,
+                                name=f"t{t}_cls")
+        outs.append(sym.expand_dims(fc, axis=1))       # [B, 1, vocab]
+    concat = sym.Concat(*outs, dim=1, name="concat")   # [B, L, vocab]
+    logits = sym.Reshape(data=concat, shape=(-1, vocab))
+    label = sym.Reshape(data=sym.Variable("softmax_label"), shape=(-1,))
+    return sym.SoftmaxOutput(data=logits, label=label, name="softmax")
+
+
+class BucketSentenceIter(mx.io.DataIter):
+    """Synthetic bucketed sentences (reference example/rnn/bucket_io.py)."""
+
+    def __init__(self, buckets, batch_size, vocab, num_hidden,
+                 num_batches=8, seed=0):
+        super().__init__()
+        self.buckets = sorted(buckets)
+        self.batch_size = batch_size
+        self.vocab = vocab
+        self.num_hidden = num_hidden
+        rng = np.random.RandomState(seed)
+        self.data = []
+        for _ in range(num_batches):
+            bucket = self.buckets[rng.randint(len(self.buckets))]
+            # next-token pattern: x[t+1] = (x[t] + 1) % vocab, learnable
+            start = rng.randint(0, vocab, (batch_size, 1))
+            seq = (start + np.arange(bucket + 1)) % vocab
+            self.data.append((bucket, seq[:, :-1].astype(np.float32),
+                              seq[:, 1:].astype(np.float32)))
+        self.default_bucket_key = max(self.buckets)
+        self._i = 0
+
+    @property
+    def provide_data(self):
+        # init states ride along as data, like the reference bucket_io
+        return [("data", (self.batch_size, self.default_bucket_key)),
+                ("l0_init_c", (self.batch_size, self.num_hidden)),
+                ("l0_init_h", (self.batch_size, self.num_hidden))]
+
+    @property
+    def provide_label(self):
+        return [("softmax_label",
+                 (self.batch_size, self.default_bucket_key))]
+
+    def reset(self):
+        self._i = 0
+
+    def next(self):
+        if self._i >= len(self.data):
+            raise StopIteration
+        bucket, X, Y = self.data[self._i]
+        self._i += 1
+        zeros = np.zeros((self.batch_size, self.num_hidden), np.float32)
+        return mx.io.DataBatch(
+            data=[mx.nd.array(X), mx.nd.array(zeros), mx.nd.array(zeros)],
+            label=[mx.nd.array(Y)],
+            bucket_key=bucket,
+            provide_data=[("data", (self.batch_size, bucket)),
+                          ("l0_init_c", (self.batch_size, self.num_hidden)),
+                          ("l0_init_h", (self.batch_size, self.num_hidden))],
+            provide_label=[("softmax_label", (self.batch_size, bucket))])
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--num-hidden", type=int, default=64)
+    ap.add_argument("--num-embed", type=int, default=32)
+    ap.add_argument("--vocab", type=int, default=16)
+    ap.add_argument("--batch-size", type=int, default=16)
+    ap.add_argument("--num-epochs", type=int, default=5)
+    ap.add_argument("--buckets", default="8,16")
+    args = ap.parse_args()
+    import logging
+    logging.basicConfig(level=logging.INFO)
+
+    buckets = [int(b) for b in args.buckets.split(",")]
+    it = BucketSentenceIter(buckets, args.batch_size, args.vocab,
+                        args.num_hidden)
+
+    def sym_gen(bucket_key):
+        net = lstm_unroll(args.num_hidden, bucket_key, args.vocab,
+                          args.num_embed)
+        return net, ("data", "l0_init_c", "l0_init_h"), ("softmax_label",)
+
+    mod = mx.mod.BucketingModule(sym_gen,
+                                 default_bucket_key=it.default_bucket_key)
+    mod.fit(it, num_epoch=args.num_epochs,
+            optimizer_params={"learning_rate": 0.5, "momentum": 0.9},
+            eval_metric="acc",
+            initializer=mx.initializer.Xavier(),
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 4))
+    score = mod.score(it, "acc")
+    print("final accuracy:", score)
+
+
+if __name__ == "__main__":
+    main()

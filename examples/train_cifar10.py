@@ -1,0 +1,72 @@
+"""Train CIFAR-10 networks (reference train_cifar10.py analog).
+
+Reads packed ``.rec`` shards through :class:`ImageRecordIter` when
+``--data-dir`` holds ``train.rec``/``test.rec`` (pack with
+``tools/im2rec.py``); with ``--synthetic`` it generates colored-blob
+classes.  Networks: ``inception-bn-28-small`` (the headline benchmark
+config), ``resnet-28-small``.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import models
+import train_model
+
+
+def synthetic_cifar(n, seed=0):
+    rng = np.random.RandomState(seed)
+    protos = rng.rand(10, 3, 28, 28).astype(np.float32)
+    y = rng.randint(0, 10, n)
+    X = protos[y] + 0.2 * rng.randn(n, 3, 28, 28).astype(np.float32)
+    return X.astype(np.float32), y.astype(np.float32)
+
+
+def get_iters(args):
+    train_rec = os.path.join(args.data_dir, "train.rec")
+    test_rec = os.path.join(args.data_dir, "test.rec")
+    if not args.synthetic and os.path.exists(train_rec):
+        mean = os.path.join(args.data_dir, "mean.npz")
+        train = mx.ImageRecordIter(
+            path_imgrec=train_rec,
+            path_imgidx=os.path.join(args.data_dir, "train.idx"),
+            data_shape=(3, 28, 28), batch_size=args.batch_size,
+            shuffle=True, rand_crop=True, rand_mirror=True,
+            mean_img=mean, scale=1.0 / 255)
+        val = None
+        if os.path.exists(test_rec):
+            val = mx.ImageRecordIter(
+                path_imgrec=test_rec,
+                path_imgidx=os.path.join(args.data_dir, "test.idx"),
+                data_shape=(3, 28, 28), batch_size=args.batch_size,
+                mean_img=mean, scale=1.0 / 255)
+        return train, val
+    X, y = synthetic_cifar(args.num_examples)
+    Xv, yv = synthetic_cifar(args.batch_size * 4, seed=1)
+    return (mx.io.NDArrayIter(X, y, batch_size=args.batch_size,
+                              shuffle=True),
+            mx.io.NDArrayIter(Xv, yv, batch_size=args.batch_size))
+
+
+def main():
+    ap = train_model.add_common_args(
+        argparse.ArgumentParser(description=__doc__))
+    ap.add_argument("--network", default="inception-bn-28-small",
+                    choices=("inception-bn-28-small", "resnet-28-small"))
+    ap.add_argument("--data-dir", default="cifar10/")
+    ap.add_argument("--synthetic", action="store_true")
+    args = ap.parse_args()
+    if args.num_examples == 60000 and args.synthetic:
+        args.num_examples = 5120
+    net = models.get_symbol(args.network)
+    train, val = get_iters(args)
+    train_model.fit(args, net, train, val)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,66 @@
+"""Train an MLP or LeNet on MNIST (reference train_mnist.py analog).
+
+Reads idx-format MNIST from ``--data-dir`` when present; with
+``--synthetic`` (or when files are missing) it trains on generated
+blob digits so the example runs in hermetic environments.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import models
+import train_model
+
+
+def synthetic_mnist(n, flat, seed=0):
+    rng = np.random.RandomState(seed)
+    protos = rng.rand(10, 28, 28).astype(np.float32)
+    y = rng.randint(0, 10, n)
+    X = protos[y] + 0.25 * rng.randn(n, 28, 28).astype(np.float32)
+    X = X.reshape(n, 784) if flat else X.reshape(n, 1, 28, 28)
+    return X.astype(np.float32), y.astype(np.float32)
+
+
+def get_iters(args, flat):
+    d = args.data_dir
+    paths = [os.path.join(d, f) for f in
+             ("train-images-idx3-ubyte", "train-labels-idx1-ubyte",
+              "t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte")]
+    if not args.synthetic and all(os.path.exists(p) for p in paths):
+        shape = (784,) if flat else (1, 28, 28)
+        train = mx.io.MNISTIter(image=paths[0], label=paths[1],
+                                input_shape=shape,
+                                batch_size=args.batch_size, shuffle=True,
+                                flat=flat)
+        val = mx.io.MNISTIter(image=paths[2], label=paths[3],
+                              input_shape=shape,
+                              batch_size=args.batch_size, flat=flat)
+        return train, val
+    X, y = synthetic_mnist(args.num_examples, flat)
+    Xv, yv = synthetic_mnist(args.batch_size * 4, flat, seed=1)
+    return (mx.io.NDArrayIter(X, y, batch_size=args.batch_size,
+                              shuffle=True),
+            mx.io.NDArrayIter(Xv, yv, batch_size=args.batch_size))
+
+
+def main():
+    ap = train_model.add_common_args(
+        argparse.ArgumentParser(description=__doc__))
+    ap.add_argument("--network", default="mlp", choices=("mlp", "lenet"))
+    ap.add_argument("--data-dir", default="mnist/")
+    ap.add_argument("--synthetic", action="store_true")
+    args = ap.parse_args()
+    if args.num_examples == 60000 and args.synthetic:
+        args.num_examples = 6000
+    net = models.get_symbol(args.network)
+    train, val = get_iters(args, flat=args.network == "mlp")
+    train_model.fit(args, net, train, val)
+
+
+if __name__ == "__main__":
+    main()

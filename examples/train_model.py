@@ -1,0 +1,96 @@
+"""Shared training harness for the image-classification examples.
+
+The analog of the reference's ``example/image-classification/
+train_model.py``: builds the kvstore, optimizer, checkpoint callbacks and
+drives ``FeedForward.fit`` — TPU-first defaults (one chip = one ctx;
+multi-device data parallelism via ``--num-devices`` uses the mesh-sharded
+trainer instead of per-device Python slicing).
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import mxnet_tpu as mx
+
+
+def add_common_args(ap: argparse.ArgumentParser):
+    ap.add_argument("--batch-size", type=int, default=128)
+    ap.add_argument("--num-epochs", type=int, default=10)
+    ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--lr-factor", type=float, default=1.0,
+                    help="reduce lr by this factor every lr-factor-epoch")
+    ap.add_argument("--lr-factor-epoch", type=float, default=1.0)
+    ap.add_argument("--momentum", type=float, default=0.9)
+    ap.add_argument("--wd", type=float, default=0.0001)
+    ap.add_argument("--optimizer", default="sgd")
+    ap.add_argument("--num-devices", type=int, default=1,
+                    help=">1 trains data-parallel on a device mesh")
+    ap.add_argument("--kv-store", default="local")
+    ap.add_argument("--model-prefix", default=None)
+    ap.add_argument("--load-epoch", type=int, default=None)
+    ap.add_argument("--num-examples", type=int, default=60000)
+    return ap
+
+
+def fit(args, net, train_iter, val_iter=None):
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(message)s")
+    kv = None
+    if "dist" in args.kv_store:
+        kv = mx.kvstore.create(args.kv_store)
+
+    lr_scheduler = None
+    if args.lr_factor < 1.0:
+        step = max(int(args.num_examples / args.batch_size
+                       * args.lr_factor_epoch), 1)
+        lr_scheduler = mx.lr_scheduler.FactorScheduler(
+            step=step, factor=args.lr_factor)
+
+    arg_params = aux_params = None
+    begin_epoch = 0
+    if args.model_prefix and args.load_epoch is not None:
+        net, arg_params, aux_params = mx.load_checkpoint(
+            args.model_prefix, args.load_epoch)
+        begin_epoch = args.load_epoch
+
+    checkpoint = (mx.callback.do_checkpoint(args.model_prefix)
+                  if args.model_prefix else None)
+
+    if args.num_devices > 1:
+        # mesh-native data parallelism: one compiled step over all chips
+        from mxnet_tpu.parallel import ShardedTrainer, make_mesh
+        import jax
+        mesh = make_mesh({"data": args.num_devices},
+                         jax.devices()[:args.num_devices])
+        trainer = ShardedTrainer(
+            net, optimizer=args.optimizer,
+            optimizer_params={"learning_rate": args.lr,
+                              "momentum": args.momentum, "wd": args.wd,
+                              "lr_scheduler": lr_scheduler},
+            mesh=mesh, initializer=mx.initializer.Xavier())
+        shapes = dict(train_iter.provide_data + train_iter.provide_label)
+        trainer.bind(data_shapes=shapes)
+        if arg_params:
+            trainer.set_params(arg_params, aux_params)
+        trainer.fit(train_iter, eval_data=val_iter, eval_metric="acc",
+                    num_epoch=args.num_epochs,
+                    batch_end_callback=mx.callback.Speedometer(
+                        args.batch_size, 50),
+                    epoch_end_callback=checkpoint)
+        return trainer
+
+    model = mx.FeedForward(
+        symbol=net, ctx=mx.context.default_ctx(),
+        num_epoch=args.num_epochs, begin_epoch=begin_epoch,
+        optimizer=args.optimizer, learning_rate=args.lr,
+        momentum=args.momentum, wd=args.wd, lr_scheduler=lr_scheduler,
+        initializer=mx.initializer.Xavier(),
+        arg_params=arg_params, aux_params=aux_params)
+    model.fit(X=train_iter, eval_data=val_iter, kvstore=kv,
+              batch_end_callback=mx.callback.Speedometer(args.batch_size,
+                                                         50),
+              epoch_end_callback=checkpoint)
+    return model

@@ -1,0 +1,73 @@
+"""Train the transformer LM with data + sequence parallelism.
+
+The long-context showcase: ring attention shards the sequence over the
+``seq`` mesh axis (``--mesh data:2,seq:4``), so per-chip attention memory
+is O(L/N) while results match dense attention exactly.  Runs on any
+device set (virtual CPU mesh included: ``XLA_FLAGS=
+--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu``).
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def parse_mesh(spec):
+    axes = {}
+    for part in spec.split(","):
+        name, size = part.split(":")
+        axes[name] = int(size)
+    return axes
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--mesh", default="data:1",
+                    help="axis:size list, e.g. data:2,seq:4")
+    ap.add_argument("--vocab", type=int, default=64)
+    ap.add_argument("--num-layers", type=int, default=2)
+    ap.add_argument("--d-model", type=int, default=64)
+    ap.add_argument("--heads", type=int, default=4)
+    ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--seq-len", type=int, default=128)
+    ap.add_argument("--steps", type=int, default=100)
+    ap.add_argument("--lr", type=float, default=0.01)
+    args = ap.parse_args()
+
+    import jax
+    from mxnet_tpu import models
+    from mxnet_tpu.parallel import ShardedTrainer, make_mesh
+
+    mesh = make_mesh(parse_mesh(args.mesh))
+    print("mesh:", dict(mesh.shape))
+    net = models.get_symbol(
+        "transformer-lm", vocab_size=args.vocab,
+        num_layers=args.num_layers, d_model=args.d_model,
+        heads=args.heads, batch_size=args.batch_size,
+        seq_len=args.seq_len)
+    trainer = ShardedTrainer(net, optimizer="adam",
+                             optimizer_params={"learning_rate": args.lr},
+                             mesh=mesh)
+    trainer.bind(data_shapes={"data": (args.batch_size, args.seq_len)},
+                 label_shapes={"softmax_label": (args.batch_size,
+                                                 args.seq_len)})
+
+    rng = np.random.RandomState(0)
+    b, l = args.batch_size, args.seq_len
+    for step in range(args.steps):
+        start = rng.randint(0, args.vocab, (b, 1))
+        seq = (start + np.arange(l + 1)) % args.vocab   # +1 pattern
+        X = seq[:, :-1].astype(np.float32)
+        Y = seq[:, 1:].astype(np.float32)
+        out = trainer.step({"data": X, "softmax_label": Y})
+        if step % 20 == 19:
+            pred = np.asarray(out[0]).argmax(-1).reshape(b, l)
+            print(f"step {step + 1}: next-token acc "
+                  f"{(pred == Y).mean():.3f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -55,6 +55,7 @@ from . import operator as opr
 from . import monitor
 from .monitor import Monitor
 from . import rtc
+from . import predictor
 from . import visualization
 from . import visualization as viz
 

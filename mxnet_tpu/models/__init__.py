@@ -10,8 +10,10 @@ from .mnist import mlp, lenet
 from .inception import inception_bn_small
 from .resnet import resnet_cifar, resnet
 from .classic import alexnet, vgg
+from .transformer import transformer_lm
 
 _ZOO = {
+    "transformer-lm": transformer_lm,
     "mlp": mlp,
     "lenet": lenet,
     "inception-bn-28-small": inception_bn_small,

@@ -1,0 +1,66 @@
+"""Decoder-only transformer LM on the Symbol API.
+
+The long-context flagship: attention runs through the ``RingAttention``
+op, which turns into sequence-parallel ring attention whenever a mesh
+with a ``seq`` axis is active (``mxnet_tpu.parallel.default_mesh``) —
+the capability upgrade over the reference's bucketed-RNN story
+(SURVEY §2.4/§7 item 10).
+
+Shapes are baked per config (batch/seq len) because the 2016-era
+``FullyConnected`` flattens trailing dims, so per-position projections go
+through explicit ``Reshape``s — the same static-unroll style as the
+reference's ``example/rnn/lstm.py``.
+"""
+from .. import symbol as sym
+
+
+def _linear(x, b, l, d_in, d_out, name):
+    """Per-position linear: [B, L, d_in] -> [B, L, d_out]."""
+    h = sym.Reshape(data=x, shape=(b * l, d_in))
+    h = sym.FullyConnected(data=h, num_hidden=d_out, name=name)
+    return sym.Reshape(data=h, shape=(b, l, d_out))
+
+
+def _layernorm(x, name):
+    return sym.LayerNorm(data=x, name=name)
+
+
+def transformer_block(x, b, l, d, heads, name, causal=True):
+    hd = d // heads
+
+    def split_heads(t):
+        t = sym.Reshape(data=t, shape=(b, l, heads, hd))
+        return sym.SwapAxis(data=t, dim1=1, dim2=2)      # [B, H, L, hd]
+
+    h = _layernorm(x, f"{name}_ln1")
+    q = split_heads(_linear(h, b, l, d, d, f"{name}_q"))
+    k = split_heads(_linear(h, b, l, d, d, f"{name}_k"))
+    v = split_heads(_linear(h, b, l, d, d, f"{name}_v"))
+    att = sym.RingAttention(query=q, key=k, value=v, causal=causal,
+                            name=f"{name}_attn")
+    att = sym.SwapAxis(data=att, dim1=1, dim2=2)
+    att = sym.Reshape(data=att, shape=(b, l, d))
+    att = _linear(att, b, l, d, d, f"{name}_proj")
+    x = x + att
+    h = _layernorm(x, f"{name}_ln2")
+    h = _linear(h, b, l, d, 4 * d, f"{name}_ffn1")
+    h = sym.Activation(data=h, act_type="relu")
+    h = _linear(h, b, l, 4 * d, d, f"{name}_ffn2")
+    return x + h
+
+
+def transformer_lm(vocab_size=256, num_layers=2, d_model=64, heads=4,
+                   batch_size=8, seq_len=64, causal=True):
+    """Build the LM symbol; inputs ``data``/``softmax_label`` are
+    ``[batch, seq]`` token ids."""
+    b, l, d = batch_size, seq_len, d_model
+    net = sym.Embedding(data=sym.Variable("data"), input_dim=vocab_size,
+                        output_dim=d, name="embed")
+    for i in range(num_layers):
+        net = transformer_block(net, b, l, d, heads, f"layer{i}",
+                                causal=causal)
+    net = _layernorm(net, "final_ln")
+    net = sym.Reshape(data=net, shape=(b * l, d))
+    net = sym.FullyConnected(data=net, num_hidden=vocab_size, name="lm_head")
+    label = sym.Reshape(data=sym.Variable("softmax_label"), shape=(b * l,))
+    return sym.SoftmaxOutput(data=net, label=label, name="softmax")

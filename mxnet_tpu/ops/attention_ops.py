@@ -1,0 +1,55 @@
+"""Attention operators for the symbol layer.
+
+Not present in the 2016 reference (its long-sequence story was bucketed
+RNNs); these are the capability-upgrade ops SURVEY §7 item 10 calls for.
+``RingAttention`` transparently switches between single-shard attention
+and sequence-parallel ring attention: when a default mesh with a ``seq``
+axis of size > 1 is active (``mxnet_tpu.parallel.default_mesh``), the op
+computes exact attention with K/V blocks rotating over the ring.
+"""
+from __future__ import annotations
+
+import jax
+
+from .registry import OpDef, OpParam, register_op
+
+__all__ = []
+
+
+def _attention_fwd(ctx, params, q, k, v):
+    from ..parallel.mesh import current_mesh
+    from ..parallel.ring_attention import local_attention, ring_self_attention
+    causal = params["causal"]
+    axis = params["seq_axis"]
+    mesh = current_mesh()
+    if (mesh is not None and axis in mesh.axis_names
+            and mesh.shape[axis] > 1):
+        return ring_self_attention(q, k, v, mesh, seq_axis=axis,
+                                   causal=causal)
+    return local_attention(q, k, v, causal=causal)
+
+
+def _attention_shape(params, in_shapes):
+    q, k, v = (list(in_shapes) + [None] * 3)[:3]
+    known = next((s for s in (q, k, v) if s is not None), None)
+    if known is None:
+        return in_shapes, [None], []
+    if len(known) != 4:
+        from ..base import MXNetError
+        raise MXNetError(
+            f"RingAttention expects [batch, heads, seq, head_dim], got {known}")
+    return [tuple(known)] * 3, [tuple(q or known)], []
+
+
+register_op(OpDef(
+    name="RingAttention",
+    forward=_attention_fwd,
+    arguments=("query", "key", "value"),
+    params={
+        "causal": OpParam("causal", "bool", default=False),
+        "seq_axis": OpParam("seq_axis", "str", default="seq"),
+    },
+    infer_shape=_attention_shape,
+    doc="Exact scaled-dot-product attention over [B, H, L, D]; "
+        "sequence-parallel (ring) when a seq-sharded mesh is active.",
+))

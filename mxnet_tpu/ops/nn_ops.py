@@ -1256,3 +1256,34 @@ register_op(OpDef(
     infer_shape=elemwise_shape,
     doc="Device-boundary copy marker; XLA/sharding layer realizes the transfer.",
 ))
+
+
+# ---------------------------------------------------------------------------
+# LayerNorm — capability upgrade beyond the 2016 reference op set (needed by
+# the transformer zoo models; the reference's only norms are BatchNorm/LRN).
+# ---------------------------------------------------------------------------
+
+def _layernorm_fwd(ctx, params, x, gamma, beta):
+    eps = params["eps"]
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    xhat = (x - mean) * jax.lax.rsqrt(var + eps)
+    return xhat * gamma + beta
+
+
+def _layernorm_shape(params, in_shapes):
+    d, g, b = (list(in_shapes) + [None] * 3)[:3]
+    if d is None:
+        return in_shapes, [None], []
+    feat = (d[-1],)
+    return [tuple(d), feat, feat], [tuple(d)], []
+
+
+register_op(OpDef(
+    name="LayerNorm",
+    forward=_layernorm_fwd,
+    arguments=("data", "gamma", "beta"),
+    params={"eps": OpParam("eps", "float", default=1e-5)},
+    infer_shape=_layernorm_shape,
+    doc="Last-axis layer normalization with learnable scale/shift.",
+))

@@ -74,13 +74,17 @@ class Optimizer:
     _needs_rng = False
     _JIT_STEPS: Dict[Any, Any] = {}
 
-    def __init__(self, rescale_grad: float = 1.0, param_idx2name: Optional[Dict[int, str]] = None,
+    def __init__(self, rescale_grad: Optional[float] = None,
+                 param_idx2name: Optional[Dict[int, str]] = None,
                  wd: float = 0.0, clip_gradient: Optional[float] = None,
                  learning_rate: float = 0.01,
                  lr_scheduler: Optional[LRScheduler] = None,
                  sym=None, begin_num_update: int = 0,
                  arg_names=None, **kwargs):
-        self.rescale_grad = rescale_grad
+        # None = "caller did not choose": callers that batch-rescale by
+        # default (ShardedTrainer.bind) key off _rescale_set
+        self._rescale_set = rescale_grad is not None
+        self.rescale_grad = 1.0 if rescale_grad is None else rescale_grad
         self.lr = learning_rate
         self.lr_scheduler = lr_scheduler
         if lr_scheduler is not None:
@@ -405,7 +409,7 @@ class Test(Optimizer):
         return new_w, new_w
 
 
-def create(name: str, rescale_grad: float = 1.0, **kwargs) -> Optimizer:
+def create(name: str, rescale_grad: Optional[float] = None, **kwargs) -> Optimizer:
     """Create an optimizer by registered name (reference ``create_optimizer``)."""
     try:
         klass = OPTIMIZER_REGISTRY.get(name)

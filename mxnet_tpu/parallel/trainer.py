@@ -37,7 +37,8 @@ from ..base import MXNetError
 from ..graph_eval import eval_symbol
 from ..context import Context, cpu
 from ..ndarray import NDArray, array as nd_array
-from .mesh import DATA_AXIS, batch_sharding, data_parallel_mesh, replicated
+from .mesh import (DATA_AXIS, SEQ_AXIS, batch_sharding, data_parallel_mesh,
+                   default_mesh, replicated)
 
 __all__ = ["ShardingRules", "ShardedTrainer"]
 
@@ -83,23 +84,41 @@ class ShardedTrainer:
 
     def __init__(self, symbol, optimizer="sgd", optimizer_params=None,
                  mesh: Optional[Mesh] = None, rules: Optional[ShardingRules] = None,
-                 data_axis: str = DATA_AXIS, initializer=None,
+                 data_axis: Optional[str] = None, initializer=None,
+                 matmul_precision: Optional[str] = None,
                  logger=None):
         from .. import optimizer as opt_mod
         from ..initializer import Uniform
         self.symbol = symbol
         self.mesh = mesh if mesh is not None else data_parallel_mesh()
-        if data_axis not in self.mesh.axis_names:
-            raise MXNetError(f"mesh has no axis {data_axis!r}; "
-                             f"axes: {self.mesh.axis_names}")
-        self.data_axis = data_axis
+        if data_axis is None:
+            # auto: shard the batch over DATA_AXIS when the mesh has it;
+            # a mesh without one replicates the batch (e.g. the pure
+            # seq-parallel long-context layout)
+            self.data_axis = (DATA_AXIS if DATA_AXIS in self.mesh.axis_names
+                              else None)
+        else:
+            if data_axis not in self.mesh.axis_names:
+                raise MXNetError(f"mesh has no axis {data_axis!r}; "
+                                 f"axes: {self.mesh.axis_names}")
+            self.data_axis = data_axis
         self.rules = rules or ShardingRules()
         self.initializer = initializer or Uniform(0.07)
         self.logger = logger or logging.getLogger(__name__)
         if isinstance(optimizer, str):
             optimizer = opt_mod.create(optimizer, **(optimizer_params or {}))
         self.optimizer = optimizer
+        # 'bfloat16' runs f32 matmuls/convs as single-pass bf16 on the MXU
+        # (weights/activations stay f32 in HBM; XLA casts at the MXU edge)
+        # — the TPU mixed-precision lever, vs the reference's all-f32 path
+        self.matmul_precision = matmul_precision
         self._bound = False
+
+    def _precision_scope(self):
+        import contextlib
+        if self.matmul_precision is None:
+            return contextlib.nullcontext()
+        return jax.default_matmul_precision(self.matmul_precision)
 
     # ------------------------------------------------------------------
     # Bind: infer shapes, initialize + place params, compile the step
@@ -114,7 +133,8 @@ class ShardedTrainer:
         sym = self.symbol
         input_shapes = dict(data_shapes)
         input_shapes.update(label_shapes or {})
-        ndata = self.mesh.shape[self.data_axis]
+        ndata = (self.mesh.shape[self.data_axis]
+                 if self.data_axis is not None else 1)
         for name, shape in input_shapes.items():
             if shape[0] % ndata:
                 raise MXNetError(
@@ -156,6 +176,16 @@ class ShardedTrainer:
             aux[n] = jax.device_put(nd.data, replicated(self.mesh))
 
         opt = self.optimizer
+        # loss-head gradients are per-sample (summed into weight grads), so
+        # default rescale to 1/global-batch like the estimator path does
+        # (reference model.py rescale_grad=1/batch_size); an explicitly
+        # chosen rescale_grad wins, and the shared optimizer object is not
+        # mutated — the override lives on this trainer
+        if getattr(opt, "_rescale_set", True):
+            self._rescale_grad = opt.rescale_grad
+        else:
+            batch0 = next(iter(data_shapes.values()))[0]
+            self._rescale_grad = 1.0 / float(batch0)
         opt_state = {n: jax.tree.map(
             lambda z: jax.device_put(
                 z, NamedSharding(self.mesh, self.rules.spec_for(n))),
@@ -182,6 +212,7 @@ class ShardedTrainer:
         input_names = list(self._input_names)
         param_names = list(self._param_names)
         hyper = opt._hyper()
+        hyper["rescale_grad"] = self._rescale_grad
         step_fn = type(opt)._functional_step
         lr_mult, wd_mult = dict(self._lr_mult), dict(self._wd_mult)
         base_wd = opt.wd
@@ -232,7 +263,8 @@ class ShardedTrainer:
     def _place_batch(self, batch) -> Dict[str, jax.Array]:
         """Accept a DataBatch / dict / aligned list; shard dim 0 over the
         data axis."""
-        sh = batch_sharding(self.mesh, self.data_axis)
+        sh = (batch_sharding(self.mesh, self.data_axis)
+              if self.data_axis is not None else replicated(self.mesh))
         if hasattr(batch, "data"):  # DataBatch
             vals = list(batch.data) + list(batch.label or [])
             named = dict(zip(self._input_names, vals))
@@ -257,17 +289,22 @@ class ShardedTrainer:
         lr = (opt.lr_scheduler(self._num_update) if opt.lr_scheduler
               else opt.lr)
         placed = self._place_batch(batch)
-        self._params, self._aux, self._opt_state, heads = self._train_step(
-            self._params, self._aux, self._opt_state, placed,
-            lr, self._num_update, _random._next_key())
+        # scope the mesh so mesh-aware ops (RingAttention) pick up the seq
+        # axis when this step traces
+        with default_mesh(self.mesh), self._precision_scope():
+            self._params, self._aux, self._opt_state, heads = \
+                self._train_step(self._params, self._aux, self._opt_state,
+                                 placed, lr, self._num_update,
+                                 _random._next_key())
         return list(heads)
 
     def forward(self, batch) -> List[jax.Array]:
         """Inference forward (no aux update, no dropout)."""
         from .. import random as _random
         placed = self._place_batch(batch)
-        return list(self._eval_step(self._params, self._aux, placed,
-                                    _random._next_key()))
+        with default_mesh(self.mesh), self._precision_scope():
+            return list(self._eval_step(self._params, self._aux, placed,
+                                        _random._next_key()))
 
     # ------------------------------------------------------------------
     # Param access / training loop

@@ -1,0 +1,150 @@
+"""Deployment predictor — the C predict API analog.
+
+Parity target: reference ``include/mxnet/c_predict_api.h:40-207`` /
+``src/c_api/c_predict_api.cc`` (and the amalgamation build that ships
+only this surface): create a predictor from a symbol JSON string + a
+parameter blob, set inputs, run forward, read outputs — no training
+machinery, no optimizer, no IO subsystem.  ``Predictor`` is that flat
+surface as a class; the module-level helpers mirror the C calls.
+"""
+from __future__ import annotations
+
+import io as _io
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .base import MXNetError
+
+__all__ = ["Predictor", "create", "load_ndarray_file"]
+
+
+def load_ndarray_file(blob: bytes) -> Dict[str, "np.ndarray"]:
+    """Parse a parameter blob (the ``.params`` file format) into arrays
+    (reference ``MXNDListCreate``)."""
+    from . import ndarray as nd
+    import tempfile, os
+    with tempfile.NamedTemporaryFile(suffix=".params", delete=False) as f:
+        f.write(blob)
+        path = f.name
+    try:
+        loaded = nd.load(path)
+    finally:
+        os.unlink(path)
+    if isinstance(loaded, dict):
+        return {k: v.asnumpy() for k, v in loaded.items()}
+    return {str(i): v.asnumpy() for i, v in enumerate(loaded)}
+
+
+class Predictor:
+    """Forward-only executor over a serialized model.
+
+    Parameters
+    ----------
+    symbol_json : str
+        Symbol JSON (contents of ``prefix-symbol.json``).
+    param_blob : bytes or dict
+        ``prefix-%04d.params`` file contents (``arg:``/``aux:`` keyed), or
+        an already-parsed dict.
+    input_shapes : dict name -> shape
+        Input shapes to bind (reference ``MXPredCreate`` input spec).
+    ctx : Context, optional
+        Defaults to the best available device.
+    output_names : list of str, optional
+        Bind only up to these internal outputs (reference
+        ``MXPredCreatePartialOut``).
+    """
+
+    def __init__(self, symbol_json: str, param_blob, input_shapes,
+                 ctx=None, output_names: Optional[Sequence[str]] = None):
+        from . import symbol as sym_mod
+        from .context import default_ctx
+        from .ndarray import NDArray, zeros
+
+        symbol = sym_mod.load_json(symbol_json)
+        if output_names:
+            internals = symbol.get_internals()
+            outs = internals.list_outputs()
+            picked = []
+            for name in output_names:
+                key = name if name in outs else f"{name}_output"
+                if key not in outs:
+                    raise MXNetError(f"no internal output {name!r}")
+                picked.append(internals[key])
+            symbol = sym_mod.Group(picked) if len(picked) > 1 else picked[0]
+        self._symbol = symbol
+        self._ctx = ctx or default_ctx()
+
+        from .model import split_param_dict
+        if isinstance(param_blob, (bytes, bytearray)):
+            raw = load_ndarray_file(bytes(param_blob))
+        else:
+            raw = {k: (v.asnumpy() if hasattr(v, "asnumpy") else
+                       np.asarray(v)) for k, v in param_blob.items()}
+        arg_params, aux_params = split_param_dict(raw)
+
+        input_shapes = {k: tuple(v) for k, v in input_shapes.items()}
+        arg_shapes, _, aux_shapes = symbol.infer_shape(**input_shapes)
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+        args = {}
+        for name, shape in zip(arg_names, arg_shapes):
+            arr = zeros(shape, ctx=self._ctx)
+            if name in input_shapes:
+                pass
+            elif name in arg_params:
+                if tuple(arg_params[name].shape) != tuple(shape):
+                    raise MXNetError(
+                        f"param {name!r} shape {arg_params[name].shape} != "
+                        f"expected {shape}")
+                arr[:] = arg_params[name]
+            # else: unbound non-param arg (e.g. a loss head's label input)
+            # stays zero, as the reference predict API does
+            args[name] = arr
+        aux = {}
+        for name, shape in zip(aux_names, aux_shapes):
+            arr = zeros(shape, ctx=self._ctx)
+            if name in aux_params:
+                arr[:] = aux_params[name]
+            aux[name] = arr
+        self._exec = symbol.bind(self._ctx, args, grad_req="null",
+                                 aux_states=aux)
+        self._input_names = list(input_shapes)
+
+    # -- the MXPred* surface -------------------------------------------
+    def set_input(self, name: str, value) -> None:
+        """``MXPredSetInput``."""
+        if name not in self._input_names:
+            raise MXNetError(f"{name!r} is not a bound input")
+        self._exec.arg_dict[name][:] = np.asarray(value, dtype=np.float32)
+
+    def forward(self) -> None:
+        """``MXPredForward``."""
+        self._exec.forward(is_train=False)
+
+    def get_output(self, index: int) -> np.ndarray:
+        """``MXPredGetOutput``."""
+        return self._exec.outputs[index].asnumpy()
+
+    @property
+    def num_outputs(self) -> int:
+        return len(self._exec.outputs)
+
+    def predict(self, **inputs) -> List[np.ndarray]:
+        """Convenience: set inputs, forward, fetch all outputs."""
+        for k, v in inputs.items():
+            self.set_input(k, v)
+        self.forward()
+        return [self.get_output(i) for i in range(self.num_outputs)]
+
+
+def create(prefix: str, epoch: int, input_shapes, ctx=None,
+           output_names=None) -> Predictor:
+    """Build a Predictor from checkpoint files (``prefix-symbol.json`` +
+    ``prefix-%04d.params``) — the typical deployment entry."""
+    with open(f"{prefix}-symbol.json") as f:
+        symbol_json = f.read()
+    with open("%s-%04d.params" % (prefix, epoch), "rb") as f:
+        blob = f.read()
+    return Predictor(symbol_json, blob, input_shapes, ctx=ctx,
+                     output_names=output_names)

@@ -134,8 +134,10 @@ def test_sharded_trainer_fit_improves():
     sym = _mlp()
     x, y = _toy_batch(256, seed=3)
     train = mx.io.NDArrayIter(x, y, batch_size=64, shuffle=False)
+    # lr under mean-gradient semantics (bind defaults rescale_grad to
+    # 1/batch like the estimator path)
     tr = ShardedTrainer(sym, optimizer="sgd",
-                        optimizer_params={"learning_rate": 0.005,
+                        optimizer_params={"learning_rate": 0.3,
                                           "momentum": 0.9},
                         mesh=data_parallel_mesh())
     tr.bind({"data": (64, 8)}, {"softmax_label": (64,)})

@@ -1,0 +1,56 @@
+"""Deployment predictor tests (reference c_predict_api.h parity)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import predictor, symbol as sym
+
+
+def _train_and_checkpoint(tmp_path, prefix="m"):
+    rng = np.random.RandomState(0)
+    X = rng.rand(120, 6).astype(np.float32)
+    y = (X.sum(axis=1) > 3).astype(np.float32) + (X[:, 0] > 0.5)
+    net = sym.FullyConnected(data=sym.Variable("data"), num_hidden=16,
+                             name="fc1")
+    net = sym.Activation(data=net, act_type="relu", name="relu1")
+    net = sym.FullyConnected(data=net, num_hidden=3, name="fc2")
+    net = sym.SoftmaxOutput(data=net, name="softmax")
+    model = mx.FeedForward(net, ctx=mx.cpu(), num_epoch=4,
+                           optimizer="sgd", learning_rate=0.2,
+                           numpy_batch_size=30)
+    model.fit(X=X, y=y, kvstore=None)
+    p = str(tmp_path / prefix)
+    model.save(p)
+    return p, X, model
+
+
+def test_predictor_matches_model(tmp_path):
+    prefix, X, model = _train_and_checkpoint(tmp_path)
+    pred = predictor.create(prefix, 4, {"data": (20, 6)}, ctx=mx.cpu())
+    outs = pred.predict(data=X[:20])
+    expect = np.asarray(model.predict(
+        mx.io.NDArrayIter(X[:20], batch_size=20)))
+    np.testing.assert_allclose(outs[0], expect, rtol=1e-5)
+
+
+def test_predictor_from_blob(tmp_path):
+    prefix, X, model = _train_and_checkpoint(tmp_path)
+    with open(f"{prefix}-symbol.json") as f:
+        sjson = f.read()
+    with open(f"{prefix}-0004.params", "rb") as f:
+        blob = f.read()
+    pred = predictor.Predictor(sjson, blob, {"data": (5, 6)}, ctx=mx.cpu())
+    pred.set_input("data", X[:5])
+    pred.forward()
+    out = pred.get_output(0)
+    assert out.shape == (5, 3)
+    np.testing.assert_allclose(out.sum(axis=1), np.ones(5), rtol=1e-5)
+
+
+def test_predictor_partial_out(tmp_path):
+    """MXPredCreatePartialOut analog: read an internal layer."""
+    prefix, X, model = _train_and_checkpoint(tmp_path)
+    pred = predictor.create(prefix, 4, {"data": (5, 6)}, ctx=mx.cpu(),
+                            output_names=["relu1"])
+    (out,) = pred.predict(data=X[:5])
+    assert out.shape == (5, 16)
+    assert (out >= 0).all()  # relu output

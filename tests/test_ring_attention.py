@@ -1,0 +1,81 @@
+"""Ring attention (sequence parallelism) tests on the virtual 8-device
+CPU mesh: exact equivalence with full attention, causal masking, and
+gradients through the ring."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from mxnet_tpu.parallel import make_mesh
+from mxnet_tpu.parallel.ring_attention import (local_attention,
+                                               ring_self_attention)
+
+
+def _rand_qkv(b=2, h=3, l=32, d=8, seed=0):
+    rng = np.random.RandomState(seed)
+    mk = lambda: jnp.asarray(rng.randn(b, h, l, d).astype(np.float32) * 0.5)
+    return mk(), mk(), mk()
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_matches_full_attention(causal):
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    mesh = make_mesh({"seq": 8})
+    q, k, v = _rand_qkv()
+    ref = local_attention(q, k, v, causal=causal)
+    out = ring_self_attention(q, k, v, mesh, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_sharded_inputs_stay_sharded():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    mesh = make_mesh({"seq": 8})
+    q, k, v = _rand_qkv(l=64)
+    sh = NamedSharding(mesh, P(None, None, "seq", None))
+    qs, ks, vs = (jax.device_put(x, sh) for x in (q, k, v))
+    out = jax.jit(lambda a, b, c: ring_self_attention(a, b, c, mesh))(
+        qs, ks, vs)
+    assert out.sharding.spec == P(None, None, "seq", None)
+    ref = local_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_gradients(causal):
+    """Gradients through scan+ppermute equal full-attention gradients."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    mesh = make_mesh({"seq": 8})
+    q, k, v = _rand_qkv(b=1, h=2, l=16, d=4, seed=3)
+
+    def ring_loss(q, k, v):
+        return (ring_self_attention(q, k, v, mesh, causal=causal) ** 2).sum()
+
+    def full_loss(q, k, v):
+        return (local_attention(q, k, v, causal=causal) ** 2).sum()
+
+    g_ring = jax.grad(ring_loss, argnums=(0, 1, 2))(q, k, v)
+    g_full = jax.grad(full_loss, argnums=(0, 1, 2))(q, k, v)
+    for gr, gf, name in zip(g_ring, g_full, "qkv"):
+        np.testing.assert_allclose(np.asarray(gr), np.asarray(gf),
+                                   rtol=5e-5, atol=5e-5, err_msg=name)
+
+
+def test_ring_attention_long_sequence_memory_shape():
+    """Each shard only ever materializes L/N-length score blocks."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    mesh = make_mesh({"seq": 8})
+    # L=512 over 8 devices -> 64-long local blocks; simply check it runs
+    # and matches on a thin slice
+    q, k, v = _rand_qkv(b=1, h=1, l=512, d=8, seed=5)
+    out = ring_self_attention(q, k, v, mesh, causal=True)
+    ref = local_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=3e-5, atol=3e-5)

@@ -1,0 +1,82 @@
+"""Transformer LM + sequence-parallel training equivalence tests."""
+import numpy as np
+import pytest
+
+import jax
+
+from mxnet_tpu import models
+from mxnet_tpu.parallel import ShardedTrainer, make_mesh
+
+
+def _data(b, l, vocab, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randint(0, vocab, (b, l)).astype(np.float32)
+    return X, np.roll(X, -1, axis=1)
+
+
+def _make(b, l, vocab=32):
+    return models.get_symbol("transformer-lm", vocab_size=vocab,
+                             num_layers=2, d_model=16, heads=2,
+                             batch_size=b, seq_len=l)
+
+
+def _run_steps(mesh, b, l, steps=3, vocab=32):
+    import mxnet_tpu as mx
+    mx.random.seed(42)  # identical init draws across runs
+    sym_ = _make(b, l, vocab)
+    t = ShardedTrainer(sym_, optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1},
+                       mesh=mesh)
+    t.bind(data_shapes={"data": (b, l)},
+           label_shapes={"softmax_label": (b, l)})
+    X, Y = _data(b, l, vocab)
+    out = None
+    for _ in range(steps):
+        out = t.step({"data": X, "softmax_label": Y})
+    return np.asarray(out[0]), {n: np.asarray(v)
+                                for n, v in t._params.items()}
+
+
+def test_seq_parallel_matches_single_device():
+    """dp x sp training == single-device training, step for step."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    b, l = 4, 32
+    out_sp, params_sp = _run_steps(make_mesh({"data": 2, "seq": 4}), b, l)
+    out_1, params_1 = _run_steps(make_mesh({"data": 1},
+                                           devices=jax.devices()[:1]), b, l)
+    np.testing.assert_allclose(out_sp, out_1, rtol=2e-4, atol=2e-4)
+    for n in params_1:
+        np.testing.assert_allclose(params_sp[n], params_1[n], rtol=2e-4,
+                                   atol=2e-4, err_msg=n)
+
+
+def test_pure_seq_parallel_mesh():
+    """All 8 chips on the seq axis (the long-context layout)."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    b, l = 2, 64
+    out_sp, _ = _run_steps(make_mesh({"seq": 8}), b, l, steps=2)
+    out_1, _ = _run_steps(make_mesh({"data": 1},
+                                    devices=jax.devices()[:1]), b, l,
+                          steps=2)
+    np.testing.assert_allclose(out_sp, out_1, rtol=2e-4, atol=2e-4)
+
+
+def test_transformer_lm_learns():
+    """Tiny copy-task LM: loss head drives accuracy well above chance."""
+    b, l, vocab = 8, 16, 8
+    sym_ = _make(b, l, vocab)
+    mesh = make_mesh({"data": 1}, devices=jax.devices()[:1])
+    t = ShardedTrainer(sym_, optimizer="adam",
+                       optimizer_params={"learning_rate": 0.01}, mesh=mesh)
+    t.bind(data_shapes={"data": (b, l)},
+           label_shapes={"softmax_label": (b, l)})
+    rng = np.random.RandomState(1)
+    X = rng.randint(0, vocab, (b, l)).astype(np.float32)
+    Y = X  # identity task: predict own token
+    for _ in range(60):
+        out = t.step({"data": X, "softmax_label": Y})
+    pred = np.asarray(out[0]).argmax(-1).reshape(b, l)
+    acc = (pred == X).mean()
+    assert acc > 0.8, acc
