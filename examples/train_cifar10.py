@@ -20,8 +20,9 @@ import train_model
 
 
 def synthetic_cifar(n, seed=0):
-    rng = np.random.RandomState(seed)
-    protos = rng.rand(10, 3, 28, 28).astype(np.float32)
+    # fixed-prototype classes; `seed` varies only the noise/label draws
+    protos = np.random.RandomState(0).rand(10, 3, 28, 28).astype(np.float32)
+    rng = np.random.RandomState(seed + 100)
     y = rng.randint(0, 10, n)
     X = protos[y] + 0.2 * rng.randn(n, 3, 28, 28).astype(np.float32)
     return X.astype(np.float32), y.astype(np.float32)

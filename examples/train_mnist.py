@@ -18,8 +18,10 @@ import train_model
 
 
 def synthetic_mnist(n, flat, seed=0):
-    rng = np.random.RandomState(seed)
-    protos = rng.rand(10, 28, 28).astype(np.float32)
+    # class prototypes come from a FIXED seed so train/val share the
+    # distribution; `seed` only varies the noise and label draws
+    protos = np.random.RandomState(0).rand(10, 28, 28).astype(np.float32)
+    rng = np.random.RandomState(seed + 100)
     y = rng.randint(0, 10, n)
     X = protos[y] + 0.25 * rng.randn(n, 28, 28).astype(np.float32)
     X = X.reshape(n, 784) if flat else X.reshape(n, 1, 28, 28)

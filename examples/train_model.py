@@ -61,6 +61,10 @@ def fit(args, net, train_iter, val_iter=None):
 
     if args.num_devices > 1:
         # mesh-native data parallelism: one compiled step over all chips
+        if kv is not None:
+            raise SystemExit("--kv-store dist* drives the parameter-server "
+                             "path; use it with --num-devices 1 per worker "
+                             "(tools/launch.py starts the workers)")
         from mxnet_tpu.parallel import ShardedTrainer, make_mesh
         import jax
         mesh = make_mesh({"data": args.num_devices},
@@ -76,7 +80,7 @@ def fit(args, net, train_iter, val_iter=None):
         if arg_params:
             trainer.set_params(arg_params, aux_params)
         trainer.fit(train_iter, eval_data=val_iter, eval_metric="acc",
-                    num_epoch=args.num_epochs,
+                    num_epoch=args.num_epochs, begin_epoch=begin_epoch,
                     batch_end_callback=mx.callback.Speedometer(
                         args.batch_size, 50),
                     epoch_end_callback=checkpoint)

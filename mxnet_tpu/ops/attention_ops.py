@@ -41,6 +41,45 @@ def _attention_shape(params, in_shapes):
     return [tuple(known)] * 3, [tuple(q or known)], []
 
 
+def _moe_ffn_fwd(ctx, params, x, gate_w, w1, b1, w2, b2):
+    from ..parallel.moe import switch_ffn
+    orig = x.shape
+    if x.ndim > 2:
+        x = x.reshape(-1, orig[-1])
+    y, _ = switch_ffn(x, gate_w, w1, b1, w2, b2,
+                      capacity_factor=params["capacity_factor"])
+    return y.reshape(orig)
+
+
+def _moe_ffn_shape(params, in_shapes):
+    shapes = list(in_shapes) + [None] * (6 - len(in_shapes))
+    d = shapes[0]
+    if d is None:
+        return shapes, [None], []
+    e = params["num_experts"]
+    h = params["hidden_size"]
+    dm = d[-1]
+    return ([tuple(d), (dm, e), (e, dm, h), (e, h), (e, h, dm), (e, dm)],
+            [tuple(d)], [])
+
+
+register_op(OpDef(
+    name="MoEFFN",
+    forward=_moe_ffn_fwd,
+    arguments=("data", "gate_weight", "expert1_weight", "expert1_bias",
+               "expert2_weight", "expert2_bias"),
+    params={
+        "num_experts": OpParam("num_experts", "int", required=True),
+        "hidden_size": OpParam("hidden_size", "int", required=True),
+        "capacity_factor": OpParam("capacity_factor", "float", default=1.5),
+    },
+    infer_shape=_moe_ffn_shape,
+    doc="Top-1 (Switch) mixture-of-experts feed-forward; shard the "
+        "expert_* leading dim over the expert mesh axis for expert "
+        "parallelism.",
+))
+
+
 register_op(OpDef(
     name="RingAttention",
     forward=_attention_fwd,

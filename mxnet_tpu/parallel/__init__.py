@@ -22,6 +22,9 @@ from .mesh import (DATA_AXIS, EXPERT_AXIS, MODEL_AXIS, PIPE_AXIS, SEQ_AXIS,
                    default_mesh, make_mesh, param_sharding, replicated)
 from .collectives import allreduce_mean, allreduce_sum
 from .trainer import ShardedTrainer, ShardingRules
+from .ring_attention import local_attention, ring_attention, ring_self_attention
+from .moe import load_balance_loss, switch_ffn
+from .pipeline import pipeline_apply
 
 __all__ = [
     "Mesh", "NamedSharding", "PartitionSpec",
@@ -30,4 +33,6 @@ __all__ = [
     "batch_sharding", "param_sharding", "replicated",
     "allreduce_sum", "allreduce_mean",
     "ShardedTrainer", "ShardingRules",
+    "ring_attention", "ring_self_attention", "local_attention",
+    "switch_ffn", "load_balance_loss", "pipeline_apply",
 ]

@@ -339,17 +339,26 @@ class ShardedTrainer:
         return eval_metric
 
     def fit(self, train_data, eval_data=None, eval_metric="acc",
-            num_epoch: int = 1, batch_end_callback=None,
-            epoch_end_callback=None) -> None:
+            num_epoch: int = 1, begin_epoch: int = 0,
+            batch_end_callback=None, epoch_end_callback=None) -> None:
         """Mesh-native training loop: per batch, one compiled device step.
 
         Unlike the reference loop (``model.py:119``) there is no push/pull
-        phase — gradient reduction is inside :meth:`step`.
+        phase — gradient reduction is inside :meth:`step`.  ``begin_epoch``
+        resumes checkpoint numbering and the optimizer's update count.
         """
         from ..metric import create as metric_create
         if isinstance(eval_metric, str):
             eval_metric = metric_create(eval_metric)
-        for epoch in range(num_epoch):
+        if begin_epoch and self._num_update == self.optimizer.begin_num_update:
+            # resume: advance the lr-schedule clock past the done epochs
+            try:
+                batches = sum(1 for _ in iter(train_data))
+                train_data.reset()
+            except TypeError:
+                batches = 0
+            self._num_update += begin_epoch * batches
+        for epoch in range(begin_epoch, num_epoch):
             tic = time.time()
             eval_metric.reset()
             nbatch = 0

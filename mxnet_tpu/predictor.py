@@ -9,8 +9,7 @@ surface as a class; the module-level helpers mirror the C calls.
 """
 from __future__ import annotations
 
-import io as _io
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 

@@ -1,0 +1,108 @@
+"""Mixture-of-experts + expert-parallelism tests."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+from mxnet_tpu.parallel import ShardedTrainer, ShardingRules, make_mesh
+from mxnet_tpu.parallel.moe import load_balance_loss, switch_ffn
+
+
+def _weights(e=4, d=8, h=16, seed=0):
+    rng = np.random.RandomState(seed)
+    mk = lambda *s: jnp.asarray(rng.randn(*s).astype(np.float32) * 0.2)
+    return (mk(d, e), mk(e, d, h), mk(e, h), mk(e, h, d), mk(e, d))
+
+
+def test_switch_ffn_routing_exact():
+    """Every under-capacity token gets exactly its top-1 expert's FFN
+    output scaled by the gate prob."""
+    gate_w, w1, b1, w2, b2 = _weights()
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(32, 8).astype(np.float32))
+    y, probs = switch_ffn(x, gate_w, w1, b1, w2, b2, capacity_factor=4.0)
+    probs = np.asarray(probs)
+    y = np.asarray(y)
+    for n in range(32):
+        e = probs[n].argmax()
+        h = np.maximum(np.asarray(x)[n] @ np.asarray(w1)[e]
+                       + np.asarray(b1)[e], 0)
+        expect = (h @ np.asarray(w2)[e] + np.asarray(b2)[e]) * probs[n, e]
+        np.testing.assert_allclose(y[n], expect, rtol=1e-4, atol=1e-5)
+
+
+def test_switch_ffn_capacity_drops():
+    """Tokens beyond expert capacity produce zero output."""
+    gate_w, w1, b1, w2, b2 = _weights(e=2)
+    # force every token to the same expert via a huge gate bias
+    gate_w = gate_w.at[:, 0].set(100.0)
+    x = jnp.ones((8, 8), jnp.float32)
+    y, _ = switch_ffn(x, gate_w, w1, b1, w2, b2, capacity_factor=0.5)
+    # capacity = 0.5 * 8 / 2 = 2 tokens kept, 6 dropped
+    nonzero = (np.abs(np.asarray(y)).sum(axis=1) > 1e-6).sum()
+    assert nonzero == 2, nonzero
+
+
+def test_load_balance_loss_prefers_uniform():
+    uniform = jnp.full((64, 4), 0.25)
+    skewed = jnp.asarray(np.eye(4, dtype=np.float32)[np.zeros(64, int)])
+    assert float(load_balance_loss(skewed)) > float(
+        load_balance_loss(uniform))
+
+
+def test_moe_symbol_op_and_grads():
+    net = sym.MoEFFN(data=sym.Variable("data"), num_experts=4,
+                     hidden_size=16, capacity_factor=4.0, name="moe")
+    net = sym.LinearRegressionOutput(data=net, name="lro")
+    ex = net.simple_bind(ctx=mx.cpu(), data=(16, 8), lro_label=(16, 8))
+    rng = np.random.RandomState(0)
+    for n, a in ex.arg_dict.items():
+        a[:] = rng.uniform(-0.3, 0.3, a.shape)
+    ex.forward(is_train=True)
+    assert ex.outputs[0].shape == (16, 8)
+    ex.backward()
+    for n in ("moe_expert1_weight", "moe_expert2_weight", "moe_gate_weight"):
+        assert np.abs(ex.grad_dict[n].asnumpy()).sum() > 0, n
+
+
+def test_expert_parallel_equivalence():
+    """Expert dim sharded over the expert axis == single-device run."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    b, d = 16, 8
+    net = sym.MoEFFN(data=sym.Variable("data"), num_experts=4,
+                     hidden_size=16, capacity_factor=4.0, name="moe")
+    net = sym.LinearRegressionOutput(data=net, name="lro")
+    rng = np.random.RandomState(3)
+    X = rng.randn(b, d).astype(np.float32)
+    Y = rng.randn(b, d).astype(np.float32)
+
+    def run(mesh, rules):
+        mx.random.seed(11)
+        t = ShardedTrainer(net, optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.1},
+                           mesh=mesh, rules=rules)
+        t.bind(data_shapes={"data": (b, d)},
+               label_shapes={"lro_label": (b, d)})
+        for _ in range(3):
+            out = t.step({"data": X, "lro_label": Y})
+        return np.asarray(out[0]), {n: np.asarray(v)
+                                    for n, v in t._params.items()}
+
+    rules = ShardingRules([
+        (r"moe_expert1_weight", P("expert", None, None)),
+        (r"moe_expert1_bias", P("expert", None)),
+        (r"moe_expert2_weight", P("expert", None, None)),
+        (r"moe_expert2_bias", P("expert", None)),
+    ])
+    out_ep, params_ep = run(make_mesh({"data": 2, "expert": 4}), rules)
+    out_1, params_1 = run(make_mesh({"data": 1},
+                                    devices=jax.devices()[:1]), None)
+    np.testing.assert_allclose(out_ep, out_1, rtol=2e-4, atol=2e-4)
+    for n in params_1:
+        np.testing.assert_allclose(params_ep[n], params_1[n], rtol=2e-4,
+                                   atol=2e-4, err_msg=n)

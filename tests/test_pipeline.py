@@ -1,0 +1,77 @@
+"""Pipeline-parallelism tests: GPipe schedule over the pipe axis equals
+sequential stage application, forward and backward."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mxnet_tpu.parallel import make_mesh
+from mxnet_tpu.parallel.pipeline import pipeline_apply
+
+
+def _stage_fn(p, x):
+    return jnp.tanh(x @ p["w"] + p["b"])
+
+
+def _stacked_params(s=4, d=8, seed=0):
+    rng = np.random.RandomState(seed)
+    return {"w": jnp.asarray(rng.randn(s, d, d).astype(np.float32) * 0.4),
+            "b": jnp.asarray(rng.randn(s, d).astype(np.float32) * 0.1)}
+
+
+def _sequential(params, x, s):
+    for i in range(s):
+        x = _stage_fn(jax.tree.map(lambda p: p[i], params), x)
+    return x
+
+
+@pytest.mark.parametrize("num_microbatches", [1, 2, 4])
+def test_pipeline_matches_sequential(num_microbatches):
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 devices")
+    mesh = make_mesh({"pipe": 4}, jax.devices()[:4])
+    params = _stacked_params()
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(8, 8).astype(np.float32))
+    out = pipeline_apply(_stage_fn, params, x, mesh,
+                         num_microbatches=num_microbatches)
+    ref = _sequential(params, x, 4)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_pipeline_gradients():
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 devices")
+    mesh = make_mesh({"pipe": 4}, jax.devices()[:4])
+    params = _stacked_params(seed=2)
+    rng = np.random.RandomState(3)
+    x = jnp.asarray(rng.randn(4, 8).astype(np.float32))
+
+    def pipe_loss(params, x):
+        return (pipeline_apply(_stage_fn, params, x, mesh,
+                               num_microbatches=2) ** 2).sum()
+
+    def seq_loss(params, x):
+        return (_sequential(params, x, 4) ** 2).sum()
+
+    g_pipe = jax.grad(pipe_loss)(params, x)
+    g_seq = jax.grad(seq_loss)(params, x)
+    for k in g_seq:
+        np.testing.assert_allclose(np.asarray(g_pipe[k]),
+                                   np.asarray(g_seq[k]),
+                                   rtol=5e-5, atol=5e-5, err_msg=k)
+
+
+def test_pipeline_under_jit():
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 devices")
+    mesh = make_mesh({"pipe": 4}, jax.devices()[:4])
+    params = _stacked_params(seed=4)
+    x = jnp.asarray(np.random.RandomState(5).randn(8, 8).astype(np.float32))
+    fn = jax.jit(lambda p, v: pipeline_apply(_stage_fn, p, v, mesh,
+                                             num_microbatches=4))
+    np.testing.assert_allclose(np.asarray(fn(params, x)),
+                               np.asarray(_sequential(params, x, 4)),
+                               rtol=2e-5, atol=2e-5)

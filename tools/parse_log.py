@@ -1,0 +1,62 @@
+#!/usr/bin/env python
+"""Scrape training logs into a table (reference tools/parse_log.py).
+
+Parses the logging output of ``FeedForward.fit`` / ``Module.fit`` /
+``ShardedTrainer.fit`` — epoch times, train/validation metrics,
+Speedometer throughput — and prints a per-epoch markdown table.
+"""
+import argparse
+import re
+import sys
+from collections import defaultdict
+
+EPOCH_RE = re.compile(r"Epoch\[(\d+)\]")
+TIME_RE = re.compile(r"Epoch\[(\d+)\].*?Time cost=([\d.]+)")
+VAL_RE = re.compile(r"Epoch\[(\d+)\] Validation-([\w-]+)=([\d.eE+-]+)")
+TRAIN_RE = re.compile(r"Epoch\[(\d+)\].*?Train-([\w-]+)=([\d.eE+-]+)")
+SPEED_RE = re.compile(r"Epoch\[(\d+)\].*?Speed: ([\d.]+) samples/sec")
+
+
+def parse(lines):
+    rows = defaultdict(dict)
+    speeds = defaultdict(list)
+    for line in lines:
+        m = TIME_RE.search(line)
+        if m:
+            rows[int(m.group(1))]["time"] = float(m.group(2))
+        m = VAL_RE.search(line)
+        if m:
+            rows[int(m.group(1))][f"val-{m.group(2)}"] = float(m.group(3))
+        m = TRAIN_RE.search(line)
+        if m:
+            rows[int(m.group(1))][f"train-{m.group(2)}"] = float(m.group(3))
+        m = SPEED_RE.search(line)
+        if m:
+            speeds[int(m.group(1))].append(float(m.group(2)))
+    for epoch, sp in speeds.items():
+        rows[epoch]["speed"] = sum(sp) / len(sp)
+    return rows
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("logfile", nargs="?", help="default: stdin")
+    args = ap.parse_args()
+    lines = (open(args.logfile).readlines() if args.logfile
+             else sys.stdin.readlines())
+    rows = parse(lines)
+    if not rows:
+        print("no epochs found", file=sys.stderr)
+        return 1
+    cols = sorted({k for r in rows.values() for k in r})
+    print("| epoch | " + " | ".join(cols) + " |")
+    print("|" + "---|" * (len(cols) + 1))
+    for epoch in sorted(rows):
+        cells = [f"{rows[epoch].get(c, ''):.6g}" if c in rows[epoch]
+                 else "" for c in cols]
+        print(f"| {epoch} | " + " | ".join(cells) + " |")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
