@@ -40,6 +40,11 @@ def fit(args, net, train_iter, val_iter=None):
                         format="%(asctime)s %(message)s")
     kv = None
     if "dist" in args.kv_store:
+        if args.num_devices > 1:
+            # must precede create(): server/scheduler roles block inside it
+            raise SystemExit("--kv-store dist* drives the parameter-server "
+                             "path; use it with --num-devices 1 per worker "
+                             "(tools/launch.py starts the workers)")
         kv = mx.kvstore.create(args.kv_store)
 
     lr_scheduler = None
@@ -61,10 +66,6 @@ def fit(args, net, train_iter, val_iter=None):
 
     if args.num_devices > 1:
         # mesh-native data parallelism: one compiled step over all chips
-        if kv is not None:
-            raise SystemExit("--kv-store dist* drives the parameter-server "
-                             "path; use it with --num-devices 1 per worker "
-                             "(tools/launch.py starts the workers)")
         from mxnet_tpu.parallel import ShardedTrainer, make_mesh
         import jax
         mesh = make_mesh({"data": args.num_devices},

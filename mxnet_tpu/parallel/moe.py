@@ -42,14 +42,15 @@ def switch_ffn(x, gate_w, w1, b1, w2, b2, capacity_factor: float = 1.5):
     expert_idx = jnp.argmax(probs, axis=-1)          # [N]
     gate = jnp.max(probs, axis=-1)                   # [N]
 
-    onehot = jax.nn.one_hot(expert_idx, e, dtype=x.dtype)      # [N, E]
+    # routing bookkeeping in int32 — token dtypes like bf16 cannot count
+    # past 256 and would collide capacity slots
+    onehot_i = jax.nn.one_hot(expert_idx, e, dtype=jnp.int32)  # [N, E]
     # arrival order within each expert decides who fits under capacity
-    pos = jnp.cumsum(onehot, axis=0) * onehot - onehot         # [N, E]
-    keep = (pos < cap).astype(x.dtype) * onehot
-    slot = jax.nn.one_hot(pos.astype(jnp.int32), cap,
-                          dtype=x.dtype)                        # [N, E, C]
-    dispatch = slot * keep[..., None]                           # [N, E, C]
-    combine = dispatch * gate[:, None, None]
+    pos = jnp.cumsum(onehot_i, axis=0) * onehot_i - onehot_i   # [N, E]
+    keep = ((pos < cap) & (onehot_i > 0)).astype(x.dtype)
+    slot = jax.nn.one_hot(pos, cap, dtype=x.dtype)             # [N, E, C]
+    dispatch = slot * keep[..., None]                          # [N, E, C]
+    combine = dispatch * gate.astype(x.dtype)[:, None, None]
 
     expert_in = jnp.einsum("nec,nd->ecd", dispatch, x)          # [E, C, D]
     h = jnp.einsum("ecd,edh->ech", expert_in, w1) + b1[:, None]

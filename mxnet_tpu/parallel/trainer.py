@@ -218,7 +218,15 @@ class ShardedTrainer:
         base_wd = opt.wd
         needs_rng = type(opt)._needs_rng
 
-        def train_step(params, aux, opt_state, batch, lr, t, rng):
+        # one base key captured at compile; per-step keys fold from the
+        # update counter INSIDE the program (no per-step host->device key
+        # transfer — each one is a round-trip on tunneled backends)
+        from .. import random as _random
+        base_key = _random._next_key()
+
+        def train_step(params, aux, opt_state, batch, lr, t):
+            rng = jax.random.fold_in(base_key, t)
+
             def fwd(p):
                 args = dict(p)
                 args.update(batch)
@@ -239,7 +247,8 @@ class ShardedTrainer:
             new_aux.update(auxu)
             return new_params, new_aux, new_opt, heads
 
-        def eval_step(params, aux, batch, rng):
+        def eval_step(params, aux, batch, t):
+            rng = jax.random.fold_in(base_key, t)
             args = dict(params)
             args.update(batch)
             heads, _ = eval_symbol(sym, args, aux, rng, False, topo=topo)
@@ -280,10 +289,14 @@ class ShardedTrainer:
         return out
 
     def step(self, batch) -> List[jax.Array]:
-        """Run one training step; returns the head outputs (global arrays)."""
+        """Run one training step; returns the head outputs (global arrays).
+
+        ``batch`` may be a DataBatch / dict / aligned list of host arrays,
+        or the result of a previous :meth:`place_batch` call (the
+        double-buffering hook: place batch i+1 while step i runs).
+        """
         if not self._bound:
             raise MXNetError("call bind() before step()")
-        from .. import random as _random
         self._num_update += 1
         opt = self.optimizer
         lr = (opt.lr_scheduler(self._num_update) if opt.lr_scheduler
@@ -294,17 +307,20 @@ class ShardedTrainer:
         with default_mesh(self.mesh), self._precision_scope():
             self._params, self._aux, self._opt_state, heads = \
                 self._train_step(self._params, self._aux, self._opt_state,
-                                 placed, lr, self._num_update,
-                                 _random._next_key())
+                                 placed, lr, self._num_update)
         return list(heads)
+
+    def place_batch(self, batch) -> Dict[str, jax.Array]:
+        """Asynchronously stage a batch onto the mesh (prefetch hook)."""
+        return self._place_batch(batch)
 
     def forward(self, batch) -> List[jax.Array]:
         """Inference forward (no aux update, no dropout)."""
-        from .. import random as _random
+        self._eval_count = getattr(self, "_eval_count", 0) + 1
         placed = self._place_batch(batch)
         with default_mesh(self.mesh), self._precision_scope():
             return list(self._eval_step(self._params, self._aux, placed,
-                                        _random._next_key()))
+                                        self._eval_count))
 
     # ------------------------------------------------------------------
     # Param access / training loop
@@ -352,12 +368,16 @@ class ShardedTrainer:
             eval_metric = metric_create(eval_metric)
         if begin_epoch and self._num_update == self.optimizer.begin_num_update:
             # resume: advance the lr-schedule clock past the done epochs
-            try:
-                batches = sum(1 for _ in iter(train_data))
-                train_data.reset()
-            except TypeError:
-                batches = 0
-            self._num_update += begin_epoch * batches
+            # without paying a counting pass over the data
+            batches = getattr(train_data, "steps_per_epoch", None)
+            if batches:
+                self._num_update += begin_epoch * int(batches)
+            else:
+                self.logger.warning(
+                    "fit(begin_epoch=%d): train_data has no steps_per_epoch"
+                    " attribute, lr-schedule clock not advanced (set "
+                    "optimizer.begin_num_update for exact resume)",
+                    begin_epoch)
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()
             eval_metric.reset()

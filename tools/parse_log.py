@@ -11,9 +11,12 @@ import sys
 from collections import defaultdict
 
 EPOCH_RE = re.compile(r"Epoch\[(\d+)\]")
-TIME_RE = re.compile(r"Epoch\[(\d+)\].*?Time cost=([\d.]+)")
-VAL_RE = re.compile(r"Epoch\[(\d+)\] Validation-([\w-]+)=([\d.eE+-]+)")
-TRAIN_RE = re.compile(r"Epoch\[(\d+)\].*?Train-([\w-]+)=([\d.eE+-]+)")
+# "Time cost=1.23" (FeedForward/Module) or "Elapsed=1.23s" (ShardedTrainer)
+TIME_RE = re.compile(r"Epoch\[(\d+)\].*?(?:Time cost|Elapsed)=([\d.]+)")
+VAL_RE = re.compile(
+    r"Epoch\[(\d+)\] (?:Mesh-)?Validation-([\w-]+)=([\d.eE+-]+)")
+TRAIN_RE = re.compile(
+    r"Epoch\[(\d+)\].*?(?:Mesh-)?Train-([\w-]+)=([\d.eE+-]+)")
 SPEED_RE = re.compile(r"Epoch\[(\d+)\].*?Speed: ([\d.]+) samples/sec")
 
 
