@@ -56,6 +56,7 @@ from . import monitor
 from .monitor import Monitor
 from . import rtc
 from . import predictor
+from . import profiler
 from . import visualization
 from . import visualization as viz
 

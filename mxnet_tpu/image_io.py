@@ -222,6 +222,14 @@ class ImageRecordIter(DataIter):
 
     # -- DataIter protocol ---------------------------------------------
     @property
+    def num_data(self):
+        return len(self._offsets)
+
+    @property
+    def steps_per_epoch(self):
+        return max(1, len(self._offsets) // self.batch_size)
+
+    @property
     def provide_data(self):
         return [(self.data_name, (self.batch_size,) + self.data_shape)]
 

@@ -7,7 +7,7 @@ Parity targets live under ``/root/reference/example/image-classification/``
 TPU-native Symbol API; they are fresh implementations, not transcriptions.
 """
 from .mnist import mlp, lenet
-from .inception import inception_bn_small
+from .inception import googlenet, inception_bn, inception_bn_small
 from .resnet import resnet_cifar, resnet
 from .classic import alexnet, vgg
 from .transformer import transformer_lm
@@ -17,6 +17,8 @@ _ZOO = {
     "mlp": mlp,
     "lenet": lenet,
     "inception-bn-28-small": inception_bn_small,
+    "inception-bn": inception_bn,
+    "googlenet": googlenet,
     "resnet-28-small": resnet_cifar,
     "resnet": resnet,
     "alexnet": alexnet,

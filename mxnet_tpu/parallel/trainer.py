@@ -223,6 +223,9 @@ class ShardedTrainer:
         # transfer — each one is a round-trip on tunneled backends)
         from .. import random as _random
         base_key = _random._next_key()
+        # distinct stream for eval so eval-mode rng never correlates with
+        # the train step that shares a counter value
+        eval_key = jax.random.fold_in(base_key, 0x5EED)
 
         def train_step(params, aux, opt_state, batch, lr, t):
             rng = jax.random.fold_in(base_key, t)
@@ -248,7 +251,7 @@ class ShardedTrainer:
             return new_params, new_aux, new_opt, heads
 
         def eval_step(params, aux, batch, t):
-            rng = jax.random.fold_in(base_key, t)
+            rng = jax.random.fold_in(eval_key, t)
             args = dict(params)
             args.update(batch)
             heads, _ = eval_symbol(sym, args, aux, rng, False, topo=topo)
@@ -370,6 +373,12 @@ class ShardedTrainer:
             # resume: advance the lr-schedule clock past the done epochs
             # without paying a counting pass over the data
             batches = getattr(train_data, "steps_per_epoch", None)
+            if not batches:
+                # every built-in iterator knows its size and batch_size
+                nd_ = getattr(train_data, "num_data", None)
+                bs = getattr(train_data, "batch_size", None)
+                if nd_ and bs:
+                    batches = nd_ // bs
             if batches:
                 self._num_update += begin_epoch * int(batches)
             else:

@@ -1,0 +1,59 @@
+"""Execution profiling — capability upgrade over the reference.
+
+The reference era had no profiler (SURVEY §5: Monitor + engine debug
+logging only; MXNet's profiler came later).  On TPU the native story is
+XLA's trace viewer: this module wraps ``jax.profiler`` in the start/stop
+shape later MXNet exposed, producing TensorBoard-loadable traces of
+device compute, HLO ops, and host activity.
+
+    mx.profiler.start("/tmp/profile")
+    ... training steps ...
+    mx.profiler.stop()
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+import jax
+
+from .base import MXNetError
+
+__all__ = ["start", "stop", "trace", "annotate"]
+
+_active_dir: Optional[str] = None
+
+
+def start(log_dir: str) -> None:
+    """Begin capturing a device/host trace into ``log_dir``."""
+    global _active_dir
+    if _active_dir is not None:
+        raise MXNetError(f"profiler already running (dir={_active_dir!r})")
+    jax.profiler.start_trace(log_dir)
+    _active_dir = log_dir
+
+
+def stop() -> str:
+    """Stop the capture; returns the trace directory."""
+    global _active_dir
+    if _active_dir is None:
+        raise MXNetError("profiler is not running")
+    jax.profiler.stop_trace()
+    out, _active_dir = _active_dir, None
+    return out
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """``with mx.profiler.trace(dir): ...`` capture scope."""
+    start(log_dir)
+    try:
+        yield
+    finally:
+        stop()
+
+
+def annotate(name: str):
+    """Label a region so it shows up in the trace timeline
+    (``jax.profiler.TraceAnnotation``)."""
+    return jax.profiler.TraceAnnotation(name)

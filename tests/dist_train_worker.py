@@ -1,0 +1,61 @@
+"""Worker program for the distributed-training convergence test.
+
+Parity target: ``/root/reference/tests/nightly/dist_lenet.py`` — each
+worker trains on its own data shard (``num_parts``/``part_index`` style
+split), gradients synchronize through the dist_sync parameter server,
+and rank 0 asserts the final model reaches the accuracy gate.
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import symbol as sym  # noqa: E402
+
+
+def make_data(n=400, num_classes=4, dim=10):
+    rng = np.random.RandomState(7)  # same dataset on every worker
+    centers = rng.randn(num_classes, dim).astype(np.float32) * 3
+    y = rng.randint(0, num_classes, n)
+    X = (centers[y] + rng.randn(n, dim)).astype(np.float32)
+    return X, y.astype(np.float32)
+
+
+def main():
+    kv = mx.kvstore.create("dist_sync")   # non-workers never return
+    rank, nworkers = kv.rank, kv.num_workers
+    X, y = make_data()
+    # contiguous shard per worker (num_parts/part_index contract)
+    n = X.shape[0]
+    lo, hi = n * rank // nworkers, n * (rank + 1) // nworkers
+    Xs, ys = X[lo:hi], y[lo:hi]
+
+    net = sym.FullyConnected(data=sym.Variable("data"), num_hidden=32,
+                             name="fc1")
+    net = sym.Activation(data=net, act_type="relu", name="relu1")
+    net = sym.FullyConnected(data=net, num_hidden=4, name="fc2")
+    net = sym.SoftmaxOutput(data=net, name="softmax")
+
+    mx.random.seed(3)  # identical init on every worker
+    batch = 50
+    it = mx.io.NDArrayIter(Xs, ys, batch_size=batch,
+                           last_batch_handle="discard")
+    model = mx.FeedForward(net, ctx=mx.cpu(), num_epoch=8,
+                           optimizer="sgd", learning_rate=0.1,
+                           numpy_batch_size=batch,
+                           initializer=mx.initializer.Xavier())
+    model.fit(X=it, kvstore=kv)
+
+    # every worker scores the FULL dataset with the synchronized model
+    acc = model.score(mx.io.NDArrayIter(X, y, batch_size=batch))
+    print(f"worker {rank}: full-set accuracy {acc:.3f}", flush=True)
+    assert acc > 0.9, f"worker {rank} accuracy {acc}"
+
+
+if __name__ == "__main__":
+    main()

@@ -27,5 +27,14 @@ def test_dist_sync_exact_aggregation(num_workers, num_servers):
     code = launch_local([sys.executable, script], num_workers=num_workers,
                         num_servers=num_servers,
                         root_port=19300 + num_workers * 10 + num_servers,
-                        timeout=120)
+                        timeout=300)
+    assert code == 0
+
+
+def test_dist_training_convergence():
+    """Sharded data + dist_sync gradient sync trains to the accuracy gate
+    on every worker (reference tests/nightly/dist_lenet.py)."""
+    script = os.path.join(os.path.dirname(__file__), "dist_train_worker.py")
+    code = launch_local([sys.executable, script], num_workers=2,
+                        num_servers=1, root_port=19477, timeout=300)
     assert code == 0
