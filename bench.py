@@ -4,6 +4,8 @@ Mirrors the reference's headline number — 842 img/s on 1x GTX 980, batch
 128 (example/image-classification/README.md:204-206, BASELINE.md row 1) —
 on one TPU chip: full training steps (forward + backward + SGD-momentum
 update compiled as a single XLA program) over synthetic CIFAR-shaped data.
+``--network transformer-lm`` measures the long-context flagship in
+tokens/s instead.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 """
@@ -17,6 +19,101 @@ import numpy as np
 BASELINE_IMG_S = 842.0  # 1-GPU inception-bn-28-small, batch 128
 
 
+def measure(trainer, feeds, warmup, steps):
+    """Shared timing protocol: warmup, then timed steps over a rotation
+    of pre-staged device batches (input pipeline overlapped), one sync
+    at each boundary.  Returns elapsed seconds for ``steps`` steps."""
+    import jax
+    for i in range(warmup):
+        heads = trainer.step(feeds[i % len(feeds)])
+    jax.block_until_ready(heads)
+    tic = time.perf_counter()
+    for i in range(steps):
+        heads = trainer.step(feeds[i % len(feeds)])
+    jax.block_until_ready(heads)
+    return time.perf_counter() - tic
+
+
+def report(metric, value, unit, vs_baseline, elapsed, steps, precision):
+    import jax
+    print(json.dumps({
+        "metric": metric,
+        "value": round(value, 1),
+        "unit": unit,
+        "vs_baseline": vs_baseline,
+        "step_ms": round(1000 * elapsed / steps, 2),
+        "n_devices": len(jax.devices()),
+        "precision": precision,
+    }))
+
+
+def bench_image(args):
+    import jax
+    from mxnet_tpu import models
+    from mxnet_tpu.parallel import ShardedTrainer, make_mesh
+
+    batch = args.batch_size
+    image = tuple(int(x) for x in args.image_shape.split(","))
+    sym = models.get_symbol(args.network, num_classes=args.num_classes)
+    mesh = make_mesh({"data": len(jax.devices())})
+    trainer = ShardedTrainer(
+        sym, mesh=mesh, optimizer="sgd",
+        optimizer_params={"learning_rate": 0.05, "momentum": 0.9,
+                          "wd": 0.0001},
+        matmul_precision=args.precision)
+    trainer.bind(data_shapes={"data": (batch,) + image},
+                 label_shapes={"softmax_label": (batch,)})
+    rng = np.random.RandomState(0)
+    feeds = [trainer.place_batch(
+        {"data": rng.rand(batch, *image).astype(np.float32),
+         "softmax_label": rng.randint(0, 10, (batch,)).astype(np.float32)})
+        for _ in range(4)]
+    elapsed = measure(trainer, feeds, args.warmup, args.steps)
+    img_s = args.steps * batch / elapsed
+    # the 842 img/s baseline row is the inception CIFAR config; other
+    # networks have no reference-published img/s to compare against
+    vs = (round(img_s / BASELINE_IMG_S, 3)
+          if args.network == "inception-bn-28-small" else None)
+    report(f"{args.network} train throughput (batch {batch}, "
+           f"{jax.devices()[0].device_kind})",
+           img_s, "img/s", vs, elapsed, args.steps, args.precision)
+    return 0
+
+
+def bench_lm(args):
+    """Transformer-LM training throughput in tokens/s (the long-context
+    flagship; no 2016-reference analog, so vs_baseline is null)."""
+    import jax
+    from mxnet_tpu import models
+    from mxnet_tpu.parallel import ShardedTrainer, make_mesh
+
+    b, l = args.batch_size, args.seq_len
+    vocab = 32000
+    sym = models.get_symbol(
+        "transformer-lm", vocab_size=vocab, num_layers=args.num_layers,
+        d_model=args.d_model, heads=max(1, args.d_model // 64),
+        batch_size=b, seq_len=l)
+    mesh = make_mesh({"data": len(jax.devices())})
+    trainer = ShardedTrainer(
+        sym, mesh=mesh, optimizer="adam",
+        optimizer_params={"learning_rate": 1e-3},
+        matmul_precision=args.precision)
+    trainer.bind(data_shapes={"data": (b, l)},
+                 label_shapes={"softmax_label": (b, l)})
+    rng = np.random.RandomState(0)
+    feeds = [trainer.place_batch(
+        {"data": rng.randint(0, vocab, (b, l)).astype(np.float32),
+         "softmax_label": rng.randint(0, vocab, (b, l)).astype(np.float32)})
+        for _ in range(2)]
+    elapsed = measure(trainer, feeds, args.warmup, args.steps)
+    tok_s = args.steps * b * l / elapsed
+    report(f"transformer-lm train throughput ({args.num_layers}L "
+           f"d{args.d_model} seq{l} batch {b}, "
+           f"{jax.devices()[0].device_kind})",
+           tok_s, "tokens/s", None, elapsed, args.steps, args.precision)
+    return 0
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--network", default="inception-bn-28-small")
@@ -25,6 +122,7 @@ def main():
     # the batch so comparisons stay transparent (baseline row used 128)
     ap.add_argument("--batch-size", type=int, default=256)
     ap.add_argument("--image-shape", default="3,28,28")
+
     def _positive(v):
         v = int(v)
         if v < 1:
@@ -36,62 +134,15 @@ def main():
     ap.add_argument("--precision", default="bfloat16",
                     choices=("bfloat16", "float32", "highest"),
                     help="MXU matmul precision for the compiled step")
+    ap.add_argument("--seq-len", type=int, default=1024,
+                    help="transformer-lm sequence length")
+    ap.add_argument("--d-model", type=int, default=512)
+    ap.add_argument("--num-layers", type=int, default=6)
     args = ap.parse_args()
 
-    import jax
-    from mxnet_tpu import models
-    from mxnet_tpu.parallel import ShardedTrainer, make_mesh
-
-    image = tuple(int(x) for x in args.image_shape.split(","))
-    batch = args.batch_size
-    sym = models.get_symbol(args.network, num_classes=args.num_classes)
-
-    mesh = make_mesh({"data": len(jax.devices())})
-    trainer = ShardedTrainer(
-        sym, mesh=mesh, optimizer="sgd",
-        optimizer_params={"learning_rate": 0.05, "momentum": 0.9,
-                          "wd": 0.0001},
-        matmul_precision=args.precision)
-    trainer.bind(data_shapes={"data": (batch,) + image},
-                 label_shapes={"softmax_label": (batch,)})
-
-    # stage a rotation of device-resident batches up front: the measured
-    # number is steady-state device throughput with the input pipeline
-    # overlapped (how PrefetchingIter/ImageRecordIter feed real training;
-    # the reference's 842 img/s is likewise prefetch-overlapped RecordIO)
-    rng = np.random.RandomState(0)
-    feeds = [trainer.place_batch(
-        {"data": rng.rand(batch, *image).astype(np.float32),
-         "softmax_label": rng.randint(0, 10, (batch,)).astype(np.float32)})
-        for _ in range(4)]
-
-    for i in range(args.warmup):
-        heads = trainer.step(feeds[i % len(feeds)])
-    jax.block_until_ready(heads)
-
-    tic = time.perf_counter()
-    for i in range(args.steps):
-        heads = trainer.step(feeds[i % len(feeds)])
-    jax.block_until_ready(heads)
-    elapsed = time.perf_counter() - tic
-
-    img_s = args.steps * batch / elapsed
-    # the 842 img/s baseline row is the inception CIFAR config; other
-    # networks have no reference-published img/s to compare against
-    vs = (round(img_s / BASELINE_IMG_S, 3)
-          if args.network == "inception-bn-28-small" else None)
-    result = {
-        "metric": f"{args.network} train throughput (batch {batch}, "
-                  f"{jax.devices()[0].device_kind})",
-        "value": round(img_s, 1),
-        "unit": "img/s",
-        "vs_baseline": vs,
-        "step_ms": round(1000 * elapsed / args.steps, 2),
-        "n_devices": len(jax.devices()),
-        "precision": args.precision,
-    }
-    print(json.dumps(result))
-    return 0
+    if args.network == "transformer-lm":
+        return bench_lm(args)
+    return bench_image(args)
 
 
 if __name__ == "__main__":

@@ -378,7 +378,7 @@ class ShardedTrainer:
                 nd_ = getattr(train_data, "num_data", None)
                 bs = getattr(train_data, "batch_size", None)
                 if nd_ and bs:
-                    batches = nd_ // bs
+                    batches = -(-nd_ // bs)  # pad/roll_over yield ceil
             if batches:
                 self._num_update += begin_epoch * int(batches)
             else:

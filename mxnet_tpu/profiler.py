@@ -38,8 +38,12 @@ def stop() -> str:
     global _active_dir
     if _active_dir is None:
         raise MXNetError("profiler is not running")
-    jax.profiler.stop_trace()
-    out, _active_dir = _active_dir, None
+    out = _active_dir
+    try:
+        jax.profiler.stop_trace()
+    finally:
+        # a failed export must not wedge the module in 'running' state
+        _active_dir = None
     return out
 
 

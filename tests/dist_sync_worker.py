@@ -14,6 +14,11 @@ os.environ.setdefault("MXNET_KVSTORE_BIGARRAY_BOUND", "4096")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")  # env var alone is
+# ignored when a TPU plugin overrides it at registration
+
 import numpy as np  # noqa: E402
 
 import mxnet_tpu as mx  # noqa: E402

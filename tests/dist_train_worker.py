@@ -12,6 +12,11 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")  # env var alone is
+# ignored when a TPU plugin overrides it at registration
+
 import numpy as np  # noqa: E402
 
 import mxnet_tpu as mx  # noqa: E402
