@@ -26,7 +26,16 @@ def _attention_fwd(ctx, params, q, k, v):
             and mesh.shape[axis] > 1):
         return ring_self_attention(q, k, v, mesh, seq_axis=axis,
                                    causal=causal)
-    return local_attention(q, k, v, causal=causal)
+    # single shard: dense for short sequences, flash-style blockwise
+    # (never materializes [L, L] scores) past the threshold
+    block = params["block_size"]
+    if block == 0:
+        lk = k.shape[2]
+        if lk > 2048:
+            block = 512 if lk % 512 == 0 else None
+        else:
+            block = None
+    return local_attention(q, k, v, causal=causal, block_size=block or None)
 
 
 def _attention_shape(params, in_shapes):
@@ -87,8 +96,12 @@ register_op(OpDef(
     params={
         "causal": OpParam("causal", "bool", default=False),
         "seq_axis": OpParam("seq_axis", "str", default="seq"),
+        "block_size": OpParam("block_size", "int", default=0,
+                              doc="0 = auto (dense below 2048, blockwise "
+                                  "flash-style above)"),
     },
     infer_shape=_attention_shape,
     doc="Exact scaled-dot-product attention over [B, H, L, D]; "
-        "sequence-parallel (ring) when a seq-sharded mesh is active.",
+        "sequence-parallel (ring) when a seq-sharded mesh is active, "
+        "blockwise online-softmax for long single-shard sequences.",
 ))

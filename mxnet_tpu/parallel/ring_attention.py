@@ -24,15 +24,25 @@ from jax import shard_map
 
 from .mesh import SEQ_AXIS
 
-__all__ = ["ring_attention", "ring_self_attention", "local_attention"]
+__all__ = ["ring_attention", "ring_self_attention", "local_attention",
+           "blockwise_attention"]
 
 
 def local_attention(q, k, v, *, causal=False, scale=None,
-                    q_offset=0, kv_offset=0, neg_inf=-1e30):
-    """Plain (single-shard) scaled dot-product attention on
-    ``[B, H, L, D]`` blocks, with optional causal masking in GLOBAL
-    positions (offsets give each shard its position in the full
-    sequence)."""
+                    q_offset=0, kv_offset=0, neg_inf=-1e30,
+                    block_size=None):
+    """Single-shard scaled dot-product attention on ``[B, H, L, D]``,
+    with optional causal masking in GLOBAL positions (offsets give each
+    shard its position in the full sequence).
+
+    For long sequences pass ``block_size`` (or leave the default
+    auto-switch in :func:`blockwise_attention`'s caller): the dense path
+    materializes the full ``[L, Lk]`` score matrix.
+    """
+    if block_size is not None:
+        return blockwise_attention(q, k, v, block_size, causal=causal,
+                                   scale=scale, q_offset=q_offset,
+                                   kv_offset=kv_offset, neg_inf=neg_inf)
     d = q.shape[-1]
     scale = (1.0 / jnp.sqrt(d).astype(q.dtype)) if scale is None else scale
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
@@ -43,6 +53,61 @@ def local_attention(q, k, v, *, causal=False, scale=None,
         scores = jnp.where(mask[None, None], scores, neg_inf)
     probs = jax.nn.softmax(scores, axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
+def blockwise_attention(q, k, v, block_size, *, causal=False, scale=None,
+                        q_offset=0, kv_offset=0, neg_inf=-1e30):
+    """Flash-attention-style exact attention with O(L * block) memory.
+
+    The score matrix is never materialized: a ``scan`` over key/value
+    blocks keeps running (max, sum, accumulator) statistics per query —
+    the same online softmax the ring kernel uses across chips, applied
+    within one chip — and each block step is wrapped in
+    ``jax.checkpoint`` so the backward pass recomputes block scores
+    instead of saving O(L^2) residuals.  Enables 32k+ token sequences on
+    a single chip.
+    """
+    b, h, lq, d = q.shape
+    lk = k.shape[2]
+    if lk % block_size:
+        raise ValueError(f"key length {lk} not divisible by block "
+                         f"{block_size}")
+    nblk = lk // block_size
+    f32 = jnp.float32
+    scale_ = (1.0 / jnp.sqrt(d)) if scale is None else scale
+    qpos = q_offset + jnp.arange(lq)
+    k_blocks = k.reshape(b, h, nblk, block_size, d)
+    v_blocks = v.reshape(b, h, nblk, block_size, d)
+
+    @jax.checkpoint
+    def step(carry, blk):
+        m, l, o = carry
+        k_blk, v_blk, i = blk
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k_blk).astype(f32) * scale_
+        if causal:
+            kpos = kv_offset + i * block_size + jnp.arange(block_size)
+            mask = qpos[:, None] >= kpos[None, :]
+            scores = jnp.where(mask[None, None], scores, neg_inf)
+        m_blk = jnp.max(scores, axis=-1)
+        m_new = jnp.maximum(m, m_blk)
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(scores - m_new[..., None])
+        if causal:
+            p = jnp.where(mask[None, None], p, 0.0)
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        o_new = (o * alpha[..., None]
+                 + jnp.einsum("bhqk,bhkd->bhqd", p, v_blk.astype(f32)))
+        return (m_new, l_new, o_new), None
+
+    m0 = jnp.full((b, h, lq), neg_inf, f32)
+    l0 = jnp.zeros((b, h, lq), f32)
+    o0 = jnp.zeros((b, h, lq, d), f32)
+    (m, l, o), _ = jax.lax.scan(
+        step, (m0, l0, o0),
+        (jnp.moveaxis(k_blocks, 2, 0), jnp.moveaxis(v_blocks, 2, 0),
+         jnp.arange(nblk)))
+    l = jnp.maximum(l, 1e-30)
+    return (o / l[..., None]).astype(q.dtype)
 
 
 def _ring_attention_sharded(q, k, v, *, axis_name, causal, scale, neg_inf):
