@@ -218,7 +218,12 @@ class ImageRecordIter(DataIter):
         header, img = rec_mod.unpack_img(raw)
         out = aug(img, rng)
         label = np.asarray(header.label, np.float32).reshape(-1)
-        return out, label
+        if label.size < self.label_width:
+            raise MXNetError(
+                f"record at offset {offset} carries {label.size} label "
+                f"value(s) but this iterator was created with "
+                f"label_width={self.label_width}")
+        return out, label[:self.label_width]
 
     # -- DataIter protocol ---------------------------------------------
     @property
@@ -227,7 +232,11 @@ class ImageRecordIter(DataIter):
 
     @property
     def steps_per_epoch(self):
-        return max(1, len(self._offsets) // self.batch_size)
+        # must equal the number of batches iter_next actually yields:
+        # round_batch wraps the tail (ceil); otherwise the tail is dropped
+        # (possibly 0 for a small shard — no max(1,...) fudge)
+        n, b = len(self._offsets), self.batch_size
+        return -(-n // b) if self.round_batch else n // b
 
     @property
     def provide_data(self):

@@ -152,6 +152,14 @@ class NDArrayIter(DataIter):
     def provide_label(self):
         return [(k, (self.batch_size,) + v.shape[1:]) for k, v in self.label]
 
+    @property
+    def steps_per_epoch(self):
+        # batches yielded per epoch: "pad" pads the tail batch (ceil);
+        # "discard" trimmed num_data at init so floor == ceil; "roll_over"
+        # carries the tail into the next epoch (floor, approximate)
+        n, b = self.num_data, self.batch_size
+        return -(-n // b) if self.last_batch_handle == "pad" else n // b
+
     def hard_reset(self):
         self.cursor = -self.batch_size
 

@@ -40,6 +40,14 @@ from .registry import OpDef, OpParam, elemwise_shape, register_op
 __all__ = []  # ops land in the registry
 
 
+
+def _amp_f32(x):
+    """Promote low-precision activations to f32 for stats/loss math; f32
+    and f64 pass through (x64 mode must keep full precision)."""
+    if x.dtype in (jnp.bfloat16, jnp.float16):
+        return x.astype(jnp.float32)
+    return x
+
 def _pair(v, n=2):
     if isinstance(v, (tuple, list)):
         if len(v) == 1:
@@ -132,9 +140,13 @@ register_op(OpDef(
 def _fc_fwd(ctx, params, data, weight, bias=None):
     # reference flattens trailing dims: (N, ...) -> (N, K)  (fully_connected-inl.h:70)
     x = data.reshape((data.shape[0], -1))
+    # mixed precision: the weight dtype is the compute dtype (bf16 under
+    # the AMP policy) — cast the activation at the MXU edge
+    if x.dtype != weight.dtype:
+        x = x.astype(weight.dtype)
     out = jnp.dot(x, weight.T)          # out = dot(data, wmat.T()) :76-80
     if bias is not None:
-        out = out + bias
+        out = out + bias.astype(out.dtype)
     return out
 
 
@@ -175,6 +187,10 @@ def _conv_fwd(ctx, params, data, weight, bias=None):
     stride = _pair(params["stride"])
     dilate = _pair(params["dilate"])
     pad = _pair(params["pad"])
+    # weight dtype is the compute dtype (bf16 under AMP); the MXU
+    # accumulates in f32 internally either way
+    if data.dtype != weight.dtype:
+        data = data.astype(weight.dtype)
     out = jax.lax.conv_general_dilated(
         data, weight,
         window_strides=stride,
@@ -182,12 +198,9 @@ def _conv_fwd(ctx, params, data, weight, bias=None):
         rhs_dilation=dilate,
         dimension_numbers=("NCHW", "OIHW", "NCHW"),
         feature_group_count=params["num_group"],
-        preferred_element_type=jnp.float32 if data.dtype == jnp.bfloat16 else None,
     )
-    if out.dtype != data.dtype:
-        out = out.astype(data.dtype)
     if bias is not None:
-        out = out + bias.reshape(1, -1, 1, 1)
+        out = out + bias.astype(out.dtype).reshape(1, -1, 1, 1)
     return out
 
 
@@ -271,6 +284,8 @@ def _deconv_fwd(ctx, params, data, weight, bias=None):
     g = params["num_group"]
     c_in = data.shape[1]
     f = params["num_filter"]
+    if data.dtype != weight.dtype:
+        data = data.astype(weight.dtype)
     w = weight.reshape(g, c_in // g, f // g, kh, kw)
     w = jnp.transpose(w, (0, 2, 1, 3, 4)).reshape(f, c_in // g, kh, kw)
     w = jnp.flip(w, axis=(-2, -1))
@@ -283,7 +298,7 @@ def _deconv_fwd(ctx, params, data, weight, bias=None):
         feature_group_count=g,
     )
     if bias is not None:
-        out = out + bias.reshape(1, -1, 1, 1)
+        out = out + bias.astype(out.dtype).reshape(1, -1, 1, 1)
     return out
 
 
@@ -360,9 +375,13 @@ def _pool_fwd(ctx, params, x):
     # init must be a CONCRETE scalar: a traced/array init defeats XLA's
     # monoid-reducer recognition and reverse-mode AD of the reduce_window
     # fails during jit partial-eval linearization
+    in_dtype = x.dtype
     if ptype == "max":
         init, op = np.asarray(-np.inf, x.dtype), jax.lax.max
     else:
+        # sum/avg accumulate in >=f32 (a bf16 window sum loses mantissa;
+        # global avg pool reduces thousands of elements)
+        x = _amp_f32(x)
         init, op = np.asarray(0.0, x.dtype), jax.lax.add
     out = jax.lax.reduce_window(
         x, init, op,
@@ -374,7 +393,7 @@ def _pool_fwd(ctx, params, x):
         # reference divides by the full kernel area incl. padding
         # (pooling-inl.h mshadow pool_avg semantics)
         out = out / (kh * kw)
-    return out
+    return out.astype(in_dtype)
 
 
 def _pool_shape(params, in_shapes):
@@ -420,9 +439,18 @@ def _bn_fwd(ctx, params, data, gamma, beta):
     cshape = (1, -1) + (1,) * (data.ndim - 2)
     if params["fix_gamma"]:
         gamma = jax.lax.stop_gradient(jnp.ones_like(gamma))
+    # statistics always accumulate in >=f32: a bf16 mean over N*H*W
+    # elements loses most of its mantissa; moving aux states stay f32
+    x32 = _amp_f32(data)
     if ctx.is_train and not params["use_global_stats"]:
-        mean = jnp.mean(data, axis=axes)
-        var = jnp.var(data, axis=axes)
+        # single-pass moments (E[x^2]-E[x]^2): jnp.var materializes the
+        # centered tensor (x-mean) at full activation size — real HBM
+        # traffic at 224x224 ResNet scale
+        mean = jnp.mean(x32, axis=axes)
+        # clamp: E[x^2]-E[x]^2 can go slightly negative under f32
+        # cancellation when |mean| >> std (rsqrt would then NaN)
+        var = jnp.maximum(
+            jnp.mean(jnp.square(x32), axis=axes) - jnp.square(mean), 0.0)
         ctx.aux_updates["moving_mean"] = (
             momentum * ctx.aux["moving_mean"] + (1.0 - momentum) * jax.lax.stop_gradient(mean))
         ctx.aux_updates["moving_var"] = (
@@ -431,7 +459,10 @@ def _bn_fwd(ctx, params, data, gamma, beta):
         mean = ctx.aux["moving_mean"]
         var = ctx.aux["moving_var"]
     inv = jax.lax.rsqrt(var.reshape(cshape) + eps)
-    return (data - mean.reshape(cshape)) * inv * gamma.reshape(cshape) + beta.reshape(cshape)
+    out = ((x32 - mean.reshape(cshape)) * inv
+           * gamma.astype(x32.dtype).reshape(cshape)
+           + beta.astype(x32.dtype).reshape(cshape))
+    return out.astype(data.dtype)
 
 
 def _bn_shape(params, in_shapes):
@@ -854,6 +885,11 @@ def _softmax_rows(x):
 
 def _softmax_output_core(data, label, grad_scale, ignore_label, multi_output,
                          use_ignore, normalization):
+    # loss heads compute in >=f32 regardless of the activation dtype (AMP
+    # policy: softmax/log in bf16 destroys small probabilities); the
+    # backward grad leaves in f32 and is cast by the consuming op's VJP
+    data = _amp_f32(data)
+
     @jax.custom_vjp
     def _fn(data, label):
         if multi_output and data.ndim > 2:
@@ -952,6 +988,7 @@ register_op(OpDef(
 def _regression_head(transform, grad_fn):
     def fwd(ctx, params, data, label):
         grad_scale = params["grad_scale"]
+        data = _amp_f32(data)  # loss heads compute in >=f32 (AMP)
 
         @jax.custom_vjp
         def _fn(data, label):
@@ -1265,10 +1302,12 @@ register_op(OpDef(
 
 def _layernorm_fwd(ctx, params, x, gamma, beta):
     eps = params["eps"]
-    mean = jnp.mean(x, axis=-1, keepdims=True)
-    var = jnp.var(x, axis=-1, keepdims=True)
-    xhat = (x - mean) * jax.lax.rsqrt(var + eps)
-    return xhat * gamma + beta
+    x32 = _amp_f32(x)  # stats in >=f32 under the AMP policy
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    xhat = (x32 - mean) * jax.lax.rsqrt(var + eps)
+    out = xhat * gamma.astype(x32.dtype) + beta.astype(x32.dtype)
+    return out.astype(x.dtype)
 
 
 def _layernorm_shape(params, in_shapes):

@@ -21,7 +21,7 @@ from .mesh import (DATA_AXIS, EXPERT_AXIS, MODEL_AXIS, PIPE_AXIS, SEQ_AXIS,
                    batch_sharding, current_mesh, data_parallel_mesh,
                    default_mesh, make_mesh, param_sharding, replicated)
 from .collectives import allreduce_mean, allreduce_sum
-from .trainer import ShardedTrainer, ShardingRules
+from .trainer import ShardedTrainer, ShardingRules, megatron_rules
 from .ring_attention import local_attention, ring_attention, ring_self_attention
 from .moe import load_balance_loss, switch_ffn
 from .pipeline import pipeline_apply
@@ -32,7 +32,7 @@ __all__ = [
     "make_mesh", "data_parallel_mesh", "default_mesh", "current_mesh",
     "batch_sharding", "param_sharding", "replicated",
     "allreduce_sum", "allreduce_mean",
-    "ShardedTrainer", "ShardingRules",
+    "ShardedTrainer", "ShardingRules", "megatron_rules",
     "ring_attention", "ring_self_attention", "local_attention",
     "switch_ffn", "load_balance_loss", "pipeline_apply",
 ]

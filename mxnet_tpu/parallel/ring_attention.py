@@ -45,13 +45,15 @@ def local_attention(q, k, v, *, causal=False, scale=None,
                                    kv_offset=kv_offset, neg_inf=neg_inf)
     d = q.shape[-1]
     scale = (1.0 / jnp.sqrt(d).astype(q.dtype)) if scale is None else scale
-    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    # softmax in f32 regardless of activation dtype (AMP policy), probs
+    # cast back so the PV matmul stays on the bf16 MXU path
+    scores = (jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale).astype(jnp.float32)
     if causal:
         qpos = q_offset + jnp.arange(q.shape[2])
         kpos = kv_offset + jnp.arange(k.shape[2])
         mask = qpos[:, None] >= kpos[None, :]
         scores = jnp.where(mask[None, None], scores, neg_inf)
-    probs = jax.nn.softmax(scores, axis=-1)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
 
 

@@ -115,7 +115,11 @@ class Predictor:
         """``MXPredSetInput``."""
         if name not in self._input_names:
             raise MXNetError(f"{name!r} is not a bound input")
-        self._exec.arg_dict[name][:] = np.asarray(value, dtype=np.float32)
+        # match the bound executor's dtype (int token ids, f16 deployments)
+        # instead of forcing float32
+        bound = self._exec.arg_dict[name]
+        self._exec.arg_dict[name][:] = np.asarray(
+            value, dtype=np.dtype(bound.dtype))
 
     def forward(self) -> None:
         """``MXPredForward``."""

@@ -26,8 +26,71 @@ _MAGIC = 0xced7230a
 _LEN_MASK = (1 << 29) - 1
 
 
+def _log_build_failure(reason, stderr):
+    import logging
+    msg = f"native recordio build failed ({reason}); using pure-Python engine"
+    if stderr:
+        msg += "\n" + (stderr.decode("utf-8", "replace")
+                       if isinstance(stderr, bytes) else str(stderr))[-2000:]
+    logging.getLogger(__name__).warning(msg)
+
+
+def _maybe_build(native_dir):
+    """Build libmxtpu.so from source if missing or older than recordio.cc
+    (the binary is not checked in — it is platform-specific).
+
+    Safe under concurrent imports (launch_local forks many processes):
+    an exclusive flock serializes builders, the build goes to a temp name
+    and is renamed into place atomically so a sibling never CDLLs a
+    half-written file, and a ``.build_failed`` stamp (newer than the
+    source) caches a toolchain failure so every later import skips the
+    subprocess."""
+    src = os.path.join(native_dir, "recordio.cc")
+    so = os.path.join(native_dir, "libmxtpu.so")
+    stamp = os.path.join(native_dir, ".build_failed")
+
+    def fresh(path):
+        return (os.path.exists(path)
+                and os.path.getmtime(path) >= os.path.getmtime(src))
+
+    if not os.path.exists(src) or fresh(so) or fresh(stamp):
+        return
+    import subprocess
+    try:
+        import fcntl
+        with open(os.path.join(native_dir, ".build_lock"), "w") as lockf:
+            fcntl.flock(lockf, fcntl.LOCK_EX)
+            if fresh(so) or fresh(stamp):  # a sibling built while we waited
+                return
+            tmp = f"{so}.tmp.{os.getpid()}"
+            try:
+                subprocess.run(
+                    ["make", "-C", native_dir, f"LIB={os.path.basename(tmp)}"],
+                    check=True, capture_output=True, timeout=120)
+                os.replace(tmp, so)
+            except subprocess.TimeoutExpired:
+                # transient (loaded machine): no stamp, retry next import
+                _log_build_failure("timed out after 120s", None)
+            except subprocess.CalledProcessError as e:
+                # real toolchain/compile failure: stamp so later imports
+                # skip the subprocess until recordio.cc changes
+                _log_build_failure(f"exit {e.returncode}", e.stderr)
+                with open(stamp, "w"):
+                    pass
+            except Exception as e:  # no make at all, etc.
+                _log_build_failure(repr(e), None)
+                with open(stamp, "w"):
+                    pass
+            finally:
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
+    except OSError:
+        pass  # read-only tree / no flock: fall through to existing engines
+
+
 def _load_native():
     here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    _maybe_build(os.path.join(here, "native"))
     for cand in (os.path.join(here, "native", "libmxtpu.so"),
                  os.path.join(os.path.dirname(__file__), "libmxtpu.so")):
         if os.path.exists(cand):

@@ -1,0 +1,107 @@
+"""ZeRO-1 optimizer-state sharding: equivalence + memory tests.
+
+The sharded-optimizer path (reduce-scatter grads -> update 1/N shard ->
+all-gather params) must produce the SAME training trajectory as the
+replicated path on the virtual 8-device CPU mesh, while holding 1/N of
+the optimizer state per chip.  TPU-native analog of the reference's PS
+striping of optimizer state across servers
+(src/kvstore/kvstore_dist.h:243-269).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.parallel import ShardedTrainer, make_mesh
+
+
+def _mlp():
+    data = mx.symbol.Variable("data")
+    net = mx.symbol.FullyConnected(data=data, num_hidden=64, name="fc1")
+    net = mx.symbol.Activation(data=net, act_type="relu")
+    net = mx.symbol.FullyConnected(data=net, num_hidden=10, name="fc2")
+    return mx.symbol.SoftmaxOutput(data=net, name="softmax")
+
+
+def _init_params(sym, input_shapes, seed=3):
+    arg_shapes, _, _ = sym.infer_shape(**input_shapes)
+    rng = np.random.RandomState(seed)
+    out = {}
+    for name, shape in zip(sym.list_arguments(), arg_shapes):
+        if name in input_shapes:
+            continue
+        # integer-valued params/grads make cross-path comparison exact
+        out[name] = rng.randint(-2, 3, size=shape).astype(np.float32)
+    return out
+
+def _make(shard_optimizer, arg_params, shapes, optimizer="sgd",
+          opt_params=None):
+    import jax
+    mesh = make_mesh({"data": len(jax.devices())})
+    tr = ShardedTrainer(
+        _mlp(), mesh=mesh, optimizer=optimizer,
+        optimizer_params=opt_params or {"learning_rate": 0.5, "momentum": 0.9},
+        shard_optimizer=shard_optimizer)
+    tr.bind(data_shapes={"data": shapes["data"]},
+            label_shapes={"softmax_label": shapes["softmax_label"]},
+            arg_params=arg_params)
+    return tr
+
+
+@pytest.mark.parametrize("optimizer,opt_params", [
+    ("sgd", {"learning_rate": 0.5, "momentum": 0.9}),
+    ("adam", {"learning_rate": 0.1}),
+])
+def test_zero_matches_replicated(optimizer, opt_params):
+    shapes = {"data": (16, 32), "softmax_label": (16,)}
+    sym = _mlp()
+    arg_params = _init_params(sym, shapes)
+    rng = np.random.RandomState(0)
+    batches = [{
+        "data": rng.randint(0, 3, shapes["data"]).astype(np.float32),
+        "softmax_label": rng.randint(0, 10, (16,)).astype(np.float32),
+    } for _ in range(3)]
+
+    t_rep = _make(False, arg_params, shapes, optimizer, opt_params)
+    t_zero = _make(True, arg_params, shapes, optimizer, opt_params)
+    for b in batches:
+        t_rep.step(b)
+        t_zero.step(b)
+    for n in t_rep._params:
+        a = np.asarray(t_rep._params[n])
+        b = np.asarray(t_zero._params[n])
+        np.testing.assert_allclose(
+            a, b, rtol=0, atol=0,
+            err_msg=f"param {n} diverged between ZeRO and replicated paths")
+
+
+def test_zero_shards_state_bytes():
+    import jax
+    n_dev = len(jax.devices())
+    shapes = {"data": (16, 32), "softmax_label": (16,)}
+    sym = _mlp()
+    arg_params = _init_params(sym, shapes)
+    t_rep = _make(False, arg_params, shapes)
+    t_zero = _make(True, arg_params, shapes)
+    rep = t_rep.optimizer_state_bytes_per_device()
+    zero = t_zero.optimizer_state_bytes_per_device()
+    # fc weights (32x64, 64x10) shard over 8 devices; biases (64, 10) —
+    # 64 shards, 10 stays replicated.  Expect a large reduction.
+    assert zero < rep, (rep, zero)
+    # the big fc1 weight alone dominates; per-chip bytes must shrink ~N x
+    w = t_zero._opt_state["fc1_weight"]
+    for leaf in __import__("jax").tree.leaves(w):
+        shard = leaf.sharding.shard_shape(leaf.shape)
+        assert int(np.prod(shard)) == leaf.size // n_dev, (
+            shard, leaf.shape, n_dev)
+
+
+def test_zero_spec_skips_indivisible():
+    """Params with no data-axis-divisible dim stay replicated."""
+    import jax
+    shapes = {"data": (16, 32), "softmax_label": (16,)}
+    sym = _mlp()
+    t = _make(True, _init_params(sym, shapes), shapes)
+    # fc2_bias has shape (10,): not divisible by 8 -> replicated
+    from jax.sharding import PartitionSpec as P
+    assert t._zero_specs["fc2_bias"] == P()
+    assert t._zero_specs["fc1_weight"] != P()

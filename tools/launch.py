@@ -25,6 +25,9 @@ def main():
     ap.add_argument("-n", "--num-workers", type=int, required=True)
     ap.add_argument("-s", "--num-servers", type=int, default=1)
     ap.add_argument("--launcher", default="local", choices=["local", "ssh"])
+    ap.add_argument("-H", "--hostfile", default=None,
+                    help="one host per line (ssh launcher)")
+    ap.add_argument("--ssh-bin", default="ssh")
     ap.add_argument("--root-uri", default="127.0.0.1")
     ap.add_argument("--root-port", type=int, default=9091)
     ap.add_argument("command", nargs=argparse.REMAINDER)
