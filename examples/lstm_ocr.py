@@ -1,0 +1,140 @@
+"""LSTM-CTC OCR (reference ``example/warpctc/lstm_ocr.py`` analog).
+
+The reference trains an unrolled LSTM over captcha image columns with the
+WarpCTC loss head (``plugin/warpctc``).  Same architecture here: image
+columns -> shared-weight unrolled LSTM -> per-timestep classifier ->
+``WarpCTC`` (the native-JAX CTC op, blank=0, digits are classes 1..10).
+
+Zero-dependency data: 4-digit "captchas" are synthesized as deterministic
+glyph stamps + noise, so the example runs anywhere (the reference pulls
+python-captcha + OpenCV).
+
+Run:  python examples/lstm_ocr.py   (seq-acc hits 1.0 ~batch 250:
+the long all-blank phase then a sharp breakthrough is the classic CTC
+training curve)
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from mxnet_tpu import symbol as sym
+
+SEQ_LEN = 20          # image columns (timesteps)
+HEIGHT = 16           # column height (LSTM input per step)
+NUM_LABEL = 4         # digits per captcha
+NUM_CLASSES = 11      # blank + 10 digits
+
+# digit d renders as a 3-row band at row d + a distinguishing dot row —
+# simple enough that the demo converges in a few hundred batches, with
+# the same pipeline shape as real captcha data
+_GLYPHS = np.zeros((10, HEIGHT, 4), np.float32)
+for _d in range(10):
+    _GLYPHS[_d, _d:_d + 3, :] = 1.0
+    _GLYPHS[_d, (3 * _d + 1) % HEIGHT, 1:3] = 1.0
+
+
+def gen_captcha(rng, batch_size):
+    """Returns (images [B, SEQ_LEN, HEIGHT], labels [B, NUM_LABEL])."""
+    digits = rng.randint(0, 10, (batch_size, NUM_LABEL))
+    img = np.zeros((batch_size, SEQ_LEN, HEIGHT), np.float32)
+    for b in range(batch_size):
+        for i, d in enumerate(digits[b]):
+            col = 1 + i * 5
+            img[b, col:col + 4] += _GLYPHS[d].T
+    img += rng.rand(batch_size, SEQ_LEN, HEIGHT).astype(np.float32) * 0.2
+    return img, (digits + 1).astype(np.float32)  # labels 1..10, 0=blank
+
+
+def lstm_ctc_unroll(num_hidden=64):
+    """Column-wise LSTM with a WarpCTC head (shared weights per step)."""
+    i2h_w, i2h_b = sym.Variable("i2h_weight"), sym.Variable("i2h_bias")
+    h2h_w, h2h_b = sym.Variable("h2h_weight"), sym.Variable("h2h_bias")
+    cls_w, cls_b = sym.Variable("cls_weight"), sym.Variable("cls_bias")
+    init_c, init_h = sym.Variable("init_c"), sym.Variable("init_h")
+
+    data = sym.Variable("data")                    # [B, SEQ_LEN, HEIGHT]
+    cols = sym.SliceChannel(data=data, num_outputs=SEQ_LEN, axis=1,
+                            squeeze_axis=True, name="cols")
+    c, h = init_c, init_h
+    outs = []
+    for t in range(SEQ_LEN):
+        i2h = sym.FullyConnected(data=cols[t], num_hidden=num_hidden * 4,
+                                 weight=i2h_w, bias=i2h_b, name=f"t{t}_i2h")
+        h2h = sym.FullyConnected(data=h, num_hidden=num_hidden * 4,
+                                 weight=h2h_w, bias=h2h_b, name=f"t{t}_h2h")
+        gates = sym.SliceChannel(data=i2h + h2h, num_outputs=4,
+                                 name=f"t{t}_gates")
+        in_g = sym.Activation(data=gates[0], act_type="sigmoid")
+        in_t = sym.Activation(data=gates[1], act_type="tanh")
+        f_g = sym.Activation(data=gates[2], act_type="sigmoid")
+        o_g = sym.Activation(data=gates[3], act_type="sigmoid")
+        c = (f_g * c) + (in_g * in_t)
+        h = o_g * sym.Activation(data=c, act_type="tanh")
+        fc = sym.FullyConnected(data=h, num_hidden=NUM_CLASSES,
+                                weight=cls_w, bias=cls_b, name=f"t{t}_cls")
+        outs.append(sym.expand_dims(fc, axis=0))   # [1, B, C] (time major)
+    logits = sym.Concat(*outs, dim=0, name="tconcat")      # [T, B, C]
+    logits = sym.Reshape(data=logits, shape=(-1, NUM_CLASSES))
+    return sym.WarpCTC(data=logits, label=sym.Variable("label"),
+                       input_length=SEQ_LEN, label_length=NUM_LABEL,
+                       name="ctc")
+
+
+def greedy_decode(probs):
+    """probs [T, B, C] -> list of digit strings (collapse repeats/blanks)."""
+    ids = probs.argmax(-1)                          # [T, B]
+    out = []
+    for b in range(ids.shape[1]):
+        prev, s = -1, []
+        for t in range(ids.shape[0]):
+            v = int(ids[t, b])
+            if v != prev and v != 0:
+                s.append(str(v - 1))
+            prev = v
+        out.append("".join(s))
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--num-hidden", type=int, default=64)
+    ap.add_argument("--num-batches", type=int, default=300)
+    ap.add_argument("--lr", type=float, default=0.01)
+    args = ap.parse_args()
+
+    import jax
+    from mxnet_tpu.parallel import ShardedTrainer, make_mesh
+    net = lstm_ctc_unroll(args.num_hidden)
+    B = args.batch_size
+    tr = ShardedTrainer(
+        net, mesh=make_mesh({"data": 1}, [jax.devices()[0]]),
+        optimizer="adam",  # CTC's long blank phase needs adaptive lr
+        optimizer_params={"learning_rate": args.lr})
+    tr.bind(data_shapes={"data": (B, SEQ_LEN, HEIGHT),
+                         "init_c": (B, args.num_hidden),
+                         "init_h": (B, args.num_hidden)},
+            label_shapes={"label": (B * NUM_LABEL,)})
+    rng = np.random.RandomState(0)
+    zeros = np.zeros((B, args.num_hidden), np.float32)
+    for i in range(args.num_batches):
+        img, labels = gen_captcha(rng, B)
+        probs = tr.step({"data": img, "init_c": zeros, "init_h": zeros,
+                         "label": labels.reshape(-1)})[0]
+        if (i + 1) % 10 == 0:
+            p = np.asarray(probs).reshape(SEQ_LEN, B, NUM_CLASSES)
+            decoded = greedy_decode(p)
+            truth = ["".join(str(int(d) - 1) for d in row)
+                     for row in labels]
+            acc = np.mean([d == t for d, t in zip(decoded, truth)])
+            print(f"batch {i+1}: seq-acc {acc:.2f}  "
+                  f"sample pred={decoded[0]!r} truth={truth[0]!r}")
+    print("done")
+
+
+if __name__ == "__main__":
+    main()

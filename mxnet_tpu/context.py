@@ -104,14 +104,16 @@ class Context:
                 # to cpu devices so ctx lists like [tpu(0), tpu(1)] still map
                 # onto the virtual device mesh.
                 platform = "cpu"
-            devices = jax.devices(platform)
+            # process-LOCAL devices: on a multi-host pod jax.devices() is
+            # the global list and ctx ids must address this host's chips
+            devices = jax.local_devices(backend=platform)
         elif dt in ("cpu", "cpu_pinned"):
             try:
-                devices = jax.devices("cpu")
+                devices = jax.local_devices(backend="cpu")
             except RuntimeError:
                 # Backend without a cpu client (axon tunnel): treat device 0
                 # of the default backend as host memory stand-in.
-                devices = jax.devices()
+                devices = jax.local_devices()
         else:
             raise MXNetError(f"unknown device type {dt}")
         if self.device_id >= len(devices):

@@ -51,12 +51,16 @@ def _attention_shape(params, in_shapes):
 
 
 def _moe_ffn_fwd(ctx, params, x, gate_w, w1, b1, w2, b2):
-    from ..parallel.moe import switch_ffn
+    from ..parallel.moe import moe_ffn, switch_ffn
     orig = x.shape
     if x.ndim > 2:
         x = x.reshape(-1, orig[-1])
-    y, _ = switch_ffn(x, gate_w, w1, b1, w2, b2,
-                      capacity_factor=params["capacity_factor"])
+    if params["top_k"] <= 1:
+        y, _ = switch_ffn(x, gate_w, w1, b1, w2, b2,
+                          capacity_factor=params["capacity_factor"])
+    else:
+        y, _ = moe_ffn(x, gate_w, w1, b1, w2, b2, k=params["top_k"],
+                       capacity_factor=params["capacity_factor"])
     return y.reshape(orig)
 
 
@@ -81,9 +85,10 @@ register_op(OpDef(
         "num_experts": OpParam("num_experts", "int", required=True),
         "hidden_size": OpParam("hidden_size", "int", required=True),
         "capacity_factor": OpParam("capacity_factor", "float", default=1.5),
+        "top_k": OpParam("top_k", "int", default=1),
     },
     infer_shape=_moe_ffn_shape,
-    doc="Top-1 (Switch) mixture-of-experts feed-forward; shard the "
+    doc="Top-k mixture-of-experts feed-forward (top_k=1: Switch, 2: GShard); shard the "
         "expert_* leading dim over the expert mesh axis for expert "
         "parallelism.",
 ))

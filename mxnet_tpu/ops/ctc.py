@@ -1,0 +1,143 @@
+"""CTC loss (reference WarpCTC plugin parity).
+
+The reference binds Baidu's warp-ctc as a loss op
+(``plugin/warpctc/warpctc-inl.h``): ``data`` is ``[(T*B), C]`` pre-softmax
+activations (time-major flattened), ``label`` is ``[B*L]`` flattened int
+labels with blank=0, the op's forward emits ``softmax(data)`` and its
+backward writes the CTC gradient while ignoring the head cotangent.
+
+TPU-native rebuild: the standard log-space alpha recursion over the
+extended label sequence ``[blank, l1, blank, ..., lL, blank]`` runs as a
+``lax.scan`` over time (compiler-friendly: static shapes, no Python
+control flow), and the gradient comes from plain autodiff through the
+recursion — no hand-written backward kernel needed.  Variable label
+lengths come from zero padding (a label value of ``blank`` marks the end),
+matching how the OCR example packs labels.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import OpDef, OpParam, register_op
+
+__all__ = ["ctc_loss"]
+
+_NEG = -1e30
+
+
+def ctc_loss(logits, labels, blank: int = 0):
+    """Per-sample CTC negative log likelihood.
+
+    Parameters
+    ----------
+    logits : [T, B, C] pre-softmax activations (time major).
+    labels : [B, L] int labels; values equal to ``blank`` are padding.
+    blank : int
+        Blank class index (warp-ctc convention: 0).
+
+    Returns ``[B]`` losses.  Differentiable via autodiff.
+    """
+    T, B, C = logits.shape
+    L = labels.shape[1]
+    S = 2 * L + 1
+    ldt = jnp.promote_types(logits.dtype, jnp.float32)
+    lp = jax.nn.log_softmax(logits.astype(ldt), axis=-1)
+
+    labels = labels.astype(jnp.int32)
+    lengths = jnp.sum(labels != blank, axis=1)            # [B]
+    # extended sequence z: blanks interleaved with labels
+    z = jnp.full((B, S), blank, jnp.int32)
+    z = z.at[:, 1::2].set(labels)
+    # transition s-2 -> s allowed iff z_s != blank and z_s != z_{s-2}
+    z_prev2 = jnp.concatenate([jnp.full((B, 2), -1, jnp.int32), z[:, :-2]],
+                              axis=1)
+    can_skip = (z != blank) & (z != z_prev2)              # [B, S]
+
+    def emit(lp_t):                                        # [B,C] -> [B,S]
+        return jnp.take_along_axis(lp_t, z, axis=1)
+
+    alpha0 = jnp.full((B, S), _NEG, ldt)
+    alpha0 = alpha0.at[:, 0].set(lp[0, :, blank])
+    if L > 0:
+        first = emit(lp[0])[:, 1]
+        # only valid when the label has at least one symbol
+        alpha0 = alpha0.at[:, 1].set(jnp.where(lengths > 0, first, _NEG))
+
+    def step(alpha, lp_t):
+        a1 = jnp.concatenate([jnp.full((B, 1), _NEG, ldt), alpha[:, :-1]],
+                             axis=1)
+        a2 = jnp.concatenate([jnp.full((B, 2), _NEG, ldt), alpha[:, :-2]],
+                             axis=1)
+        a2 = jnp.where(can_skip, a2, _NEG)
+        merged = jnp.logaddexp(jnp.logaddexp(alpha, a1), a2)
+        return merged + emit(lp_t), None
+
+    alpha, _ = jax.lax.scan(step, alpha0, lp[1:])
+    # finish in the last blank (index 2*len) or last symbol (2*len - 1)
+    idx_last = 2 * lengths                                 # [B]
+    a_last = jnp.take_along_axis(alpha, idx_last[:, None], axis=1)[:, 0]
+    idx_sym = jnp.maximum(idx_last - 1, 0)
+    a_sym = jnp.take_along_axis(alpha, idx_sym[:, None], axis=1)[:, 0]
+    a_sym = jnp.where(lengths > 0, a_sym, _NEG)
+    return -jnp.logaddexp(a_last, a_sym)
+
+
+def _warpctc_fwd(ctx, params, data, label):
+    """Reference-parity op: data [(T*B), C], label [B*L] flattened."""
+    T = params["input_length"]
+    L = params["label_length"]
+    TB, C = data.shape
+    B = TB // T
+
+    @jax.custom_vjp
+    def _fn(data, label):
+        dt = jnp.promote_types(data.dtype, jnp.float32)
+        return jax.nn.softmax(data.astype(dt), axis=-1)
+
+    def _f(data, label):
+        return _fn(data, label), (data, label)
+
+    def _b(res, g):
+        # CTC gradient wrt the pre-softmax activations; head cotangent is
+        # ignored exactly like the reference loss heads (warpctc-inl.h
+        # Backward writes the warp-ctc grads directly)
+        data, label = res
+        dt = jnp.promote_types(data.dtype, jnp.float32)
+        logits = data.astype(dt).reshape(T, B, C)
+        labels = label.astype(jnp.int32).reshape(B, L)
+
+        def total(lg):
+            return jnp.sum(ctc_loss(lg, labels))
+        grad = jax.grad(total)(logits).reshape(TB, C)
+        return grad.astype(data.dtype), jnp.zeros_like(label)
+
+    _fn.defvjp(_f, _b)
+    return _fn(data, label)
+
+
+def _warpctc_shape(params, in_shapes):
+    shapes = list(in_shapes) + [None] * (2 - len(in_shapes))
+    d = shapes[0]
+    if d is None:
+        return shapes, [None], []
+    T = params["input_length"]
+    L = params["label_length"]
+    B = d[0] // T
+    shapes[1] = (B * L,)
+    return shapes, [tuple(d)], []
+
+
+register_op(OpDef(
+    name="WarpCTC",
+    forward=_warpctc_fwd,
+    arguments=("data", "label"),
+    params={
+        "input_length": OpParam("input_length", "int", required=True),
+        "label_length": OpParam("label_length", "int", required=True),
+    },
+    infer_shape=_warpctc_shape,
+    doc="CTC loss head (warp-ctc plugin parity): softmax forward, CTC "
+        "gradient backward, blank=0, zero-padded labels.",
+))

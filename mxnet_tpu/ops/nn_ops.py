@@ -458,11 +458,14 @@ def _bn_fwd(ctx, params, data, gamma, beta):
     else:
         mean = ctx.aux["moving_mean"]
         var = ctx.aux["moving_var"]
-    inv = jax.lax.rsqrt(var.reshape(cshape) + eps)
-    out = ((x32 - mean.reshape(cshape)) * inv
-           * gamma.astype(x32.dtype).reshape(cshape)
-           + beta.astype(x32.dtype).reshape(cshape))
-    return out.astype(data.dtype)
+    # fold into per-channel scale/shift (f32, C elements — free) and do
+    # the full-tensor elementwise math in the ACTIVATION dtype: under AMP
+    # this keeps the big tensors bf16 instead of paying f32 HBM traffic
+    inv = jax.lax.rsqrt(var + eps)
+    scale = (gamma.astype(x32.dtype) * inv).reshape(cshape)
+    shift = (beta.astype(x32.dtype) - mean * gamma.astype(x32.dtype)
+             * inv).reshape(cshape)
+    return data * scale.astype(data.dtype) + shift.astype(data.dtype)
 
 
 def _bn_shape(params, in_shapes):

@@ -23,8 +23,9 @@ from .mesh import (DATA_AXIS, EXPERT_AXIS, MODEL_AXIS, PIPE_AXIS, SEQ_AXIS,
 from .collectives import allreduce_mean, allreduce_sum
 from .trainer import ShardedTrainer, ShardingRules, megatron_rules
 from .ring_attention import local_attention, ring_attention, ring_self_attention
-from .moe import load_balance_loss, switch_ffn
+from .moe import load_balance_loss, moe_ffn, moe_ffn_ep, switch_ffn
 from .pipeline import pipeline_apply
+from .pipeline_trainer import PipelineTrainer
 
 __all__ = [
     "Mesh", "NamedSharding", "PartitionSpec",
@@ -34,5 +35,6 @@ __all__ = [
     "allreduce_sum", "allreduce_mean",
     "ShardedTrainer", "ShardingRules", "megatron_rules",
     "ring_attention", "ring_self_attention", "local_attention",
-    "switch_ffn", "load_balance_loss", "pipeline_apply",
+    "switch_ffn", "moe_ffn", "moe_ffn_ep", "load_balance_loss", "pipeline_apply",
+    "PipelineTrainer",
 ]

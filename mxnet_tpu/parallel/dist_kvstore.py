@@ -330,6 +330,86 @@ def _connect(host: str, port: int, retries: int = 100) -> socket.socket:
 # Worker-side store
 # ---------------------------------------------------------------------------
 
+class _Lazy:
+    """Compute-once holder shared by the shard tasks of one key: the
+    device->host gradient merge runs in whichever sender thread gets
+    there first (NOT on the training thread — that is the overlap)."""
+
+    def __init__(self, fn):
+        self._fn = fn
+        self._lock = threading.Lock()
+        self._val = None
+
+    def get(self):
+        with self._lock:
+            if self._fn is not None:
+                self._val = self._fn()
+                self._fn = None
+            return self._val
+
+
+class _PrioritySender:
+    """Background sender draining a per-server priority queue.
+
+    Higher ``priority`` is sent first — the reference engine convention:
+    the training loop pushes with ``priority=-param_index``
+    (``model.py:89-99``) so the FRONT layers' comm completes first and
+    the next forward can start while deep layers still sync
+    (``kvstore_dist.h:63-141``).
+    """
+
+    def __init__(self, name=""):
+        import queue
+        self._q = queue.PriorityQueue()
+        self._seq = 0
+        self._lock = threading.Lock()
+        self._err = None
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name=f"kvsender-{name}")
+        self._thread.start()
+
+    def submit(self, priority, fn) -> threading.Event:
+        ev = threading.Event()
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+        self._q.put((-priority, seq, fn, ev))
+        return ev
+
+    def _run(self):
+        while True:
+            _, _, fn, ev = self._q.get()
+            if fn is None:
+                ev.set()
+                return
+            try:
+                fn()
+            except BaseException as e:  # surfaced at the next sync point
+                self._err = e
+            ev.set()
+
+    def raise_pending(self):
+        if self._err is not None:
+            e, self._err = self._err, None
+            raise e
+
+    def flush(self):
+        """Block until everything queued so far has been sent."""
+        # a -inf-priority marker drains after all real work
+        ev = self.submit(float("-inf"), lambda: None)
+        ev.wait()
+        self.raise_pending()
+
+    def close(self):
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+        # sort key +inf: the shutdown sentinel drains AFTER everything
+        # still queued (submit negates priority, so this sorts last)
+        self._q.put((float("inf"), seq, None, threading.Event()))
+        self._thread.join(timeout=10)
+
+
 class DistKVStore(KVStore):
     """Worker-side distributed store (reference ``KVStoreDist``)."""
 
@@ -356,6 +436,9 @@ class DistKVStore(KVStore):
         self._sched = sched
         self._server_socks = [_connect(h, p) for (h, p) in self._server_addrs]
         self._sock_locks = [threading.Lock() for _ in self._server_socks]
+        self._senders = [_PrioritySender(str(i))
+                         for i in range(len(self._server_socks))]
+        self._pending: Dict[Any, List[threading.Event]] = {}
         self._closed = False
         atexit.register(self.close)
         if kind in ("dist_sync", "dist") and self._rank == 0:
@@ -425,23 +508,52 @@ class DistKVStore(KVStore):
         return np.asarray(reduced[0])
 
     def push(self, key, value, priority: int = 0) -> None:
+        """ASYNC push: returns immediately.  The device->host gradient
+        merge and the server RPCs run on per-server sender threads in
+        ``priority`` order (``-param_index`` convention), so comm
+        overlaps the rest of backward exactly like the reference's
+        engine-wrapped ZPush (``kvstore_dist.h:63-141``)."""
         keys, values = _value_list(key, value)
         for k, vgroup in zip(keys, values):
-            arr = self._merge_local(vgroup)
-            flat = arr.reshape(-1)
-            for sid, wkey, sl in self._shards_for(k, arr):
-                self._rpc(sid, ("push", wkey, _pack_arr(flat[sl])))
+            shape, dtype = self._meta.get(
+                k, (tuple(vgroup[0].shape), np.dtype(vgroup[0].dtype)))
+            holder = _Lazy(lambda vg=list(vgroup):
+                           self._merge_local(vg).reshape(-1))
+            probe = np.empty(shape, dtype=dtype)
+            evs = self._pending.setdefault(k, [])
+            for sid, wkey, sl in self._shards_for(k, probe):
+                evs.append(self._senders[sid].submit(
+                    priority,
+                    lambda sid=sid, wkey=wkey, sl=sl, h=holder:
+                    self._rpc(sid, ("push", wkey, _pack_arr(h.get()[sl])))))
 
     def pull(self, key, out=None, priority: int = 0) -> None:
+        """Pull blocks until ``out`` is filled, but shard requests fan out
+        over the per-server sender threads concurrently; this worker's
+        outstanding pushes of the same key are flushed first (per-key
+        ordering the reference gets from engine write-deps)."""
         keys, outs = _value_list(key, out)
         for k, ogroup in zip(keys, outs):
+            for ev in self._pending.pop(k, []):
+                ev.wait()
+            for s in self._senders:
+                s.raise_pending()
             shape, dtype = self._meta.get(
                 k, (tuple(ogroup[0].shape), np.dtype(ogroup[0].dtype)))
             probe = np.empty(shape, dtype=dtype)
-            parts = []
-            for sid, wkey, sl in self._shards_for(k, probe):
-                parts.append(_unpack_arr(self._rpc(sid, ("pull", wkey))[1]))
-            merged = np.concatenate([p.reshape(-1) for p in parts]).reshape(shape)
+            shards = self._shards_for(k, probe)
+            parts: List[Any] = [None] * len(shards)
+            evs = []
+            for i, (sid, wkey, sl) in enumerate(shards):
+                def fetch(i=i, sid=sid, wkey=wkey):
+                    parts[i] = _unpack_arr(self._rpc(sid, ("pull", wkey))[1])
+                evs.append(self._senders[sid].submit(priority, fetch))
+            for ev in evs:
+                ev.wait()
+            for s in self._senders:
+                s.raise_pending()
+            merged = np.concatenate(
+                [p.reshape(-1) for p in parts]).reshape(shape)
             for o in ogroup:
                 o._write(merged)
 
@@ -458,6 +570,10 @@ class DistKVStore(KVStore):
         self._updater = updater
 
     def barrier(self) -> None:
+        # a barrier is a full sync point: everything queued must be on
+        # the wire before this worker reports in
+        for s in getattr(self, "_senders", []):
+            s.flush()
         _send(self._sched, ("barrier",))
         reply = _recv(self._sched)
         if reply[0] != "barrier_done":
@@ -474,12 +590,14 @@ class DistKVStore(KVStore):
             return
         self._closed = True
         try:
-            self.barrier()
+            self.barrier()  # flushes the sender queues first
             if self._rank == 0:
                 self.send_command_to_servers(_STOP_SERVER, b"")
             _send(self._sched, ("stop",))
         except (MXNetError, OSError):
             pass
+        for snd in self._senders:
+            snd.close()
         for s in self._server_socks + [self._sched]:
             try:
                 s.close()

@@ -15,7 +15,49 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-__all__ = ["switch_ffn", "load_balance_loss"]
+__all__ = ["switch_ffn", "moe_ffn", "moe_ffn_ep", "load_balance_loss"]
+
+
+def moe_ffn(x, gate_w, w1, b1, w2, b2, k: int = 2,
+            capacity_factor: float = 1.5):
+    """Top-k routed expert feed-forward (k=2 is the GShard default).
+
+    Each token goes to its top-k experts with gates renormalized over
+    the chosen k (GShard/Mixtral convention); per-expert capacity
+    ``C = ceil(cf * k * N / E)`` drops overflow assignments (the token
+    still passes through via its surviving assignments, or contributes
+    zero if all overflow).
+
+    Shapes as :func:`switch_ffn`; returns ``(y, router_probs)``.
+    """
+    n, d = x.shape
+    e = gate_w.shape[1]
+    k = min(k, e)
+    cap = max(1, math.ceil(capacity_factor * k * n / e))
+
+    logits = jnp.dot(x, gate_w)                       # [N, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    topv, topi = jax.lax.top_k(probs, k)              # [N, k]
+    gates = topv / jnp.maximum(jnp.sum(topv, -1, keepdims=True), 1e-9)
+
+    # capacity accounting over the flattened (token, rank) assignment
+    # stream: rank-0 assignments of earlier tokens claim slots first
+    onehot_i = jax.nn.one_hot(topi, e, dtype=jnp.int32)       # [N, k, E]
+    flat = onehot_i.reshape(n * k, e)
+    pos = (jnp.cumsum(flat, axis=0) * flat - flat).reshape(n, k, e)
+    keep = ((pos < cap) & (onehot_i > 0)).astype(x.dtype)     # [N, k, E]
+    slot = jax.nn.one_hot(pos, cap, dtype=x.dtype)            # [N, k, E, C]
+    disp_k = slot * keep[..., None]                           # [N, k, E, C]
+    dispatch = jnp.sum(disp_k, axis=1)                        # [N, E, C]
+    combine = jnp.sum(disp_k * gates.astype(x.dtype)[..., None, None],
+                      axis=1)                                 # [N, E, C]
+
+    expert_in = jnp.einsum("nec,nd->ecd", dispatch, x)        # [E, C, D]
+    h = jnp.einsum("ecd,edh->ech", expert_in, w1) + b1[:, None]
+    h = jax.nn.relu(h)
+    expert_out = jnp.einsum("ech,ehd->ecd", h, w2) + b2[:, None]
+    y = jnp.einsum("nec,ecd->nd", combine, expert_out)
+    return y, probs
 
 
 def switch_ffn(x, gate_w, w1, b1, w2, b2, capacity_factor: float = 1.5):
@@ -58,6 +100,74 @@ def switch_ffn(x, gate_w, w1, b1, w2, b2, capacity_factor: float = 1.5):
     expert_out = jnp.einsum("ech,ehd->ecd", h, w2) + b2[:, None]
     y = jnp.einsum("nec,ecd->nd", combine, expert_out)
     return y, probs
+
+
+def moe_ffn_ep(x, gate_w, w1, b1, w2, b2, mesh, k: int = 2,
+               capacity_factor: float = 1.5, expert_axis: str = "expert",
+               data_axis: str = "data"):
+    """Expert-parallel top-k MoE with an EXPLICIT token all-to-all.
+
+    The dense-dispatch formulation leaves collective choice to GSPMD
+    (which tends to all-gather activations).  This is the canonical
+    expert-parallel program instead: each chip routes its local tokens,
+    an ``all_to_all`` over the ``expert`` mesh axis moves token slots to
+    their experts' chips, the expert FFN runs on local experts only, and
+    the reverse ``all_to_all`` brings results home — comm proportional to
+    routed tokens, not to the full activation tensor.
+
+    ``x`` must be sharded ``P((data_axis, expert_axis), None)`` — tokens
+    split over ALL chips, the canonical EP layout; expert weights
+    ``P(expert_axis, ...)`` (replicated over ``data``, so their grads
+    psum over it in the transpose).  Returns ``y`` sharded like ``x``.
+    """
+    from jax.experimental.shard_map import shard_map
+    from functools import partial
+    from jax.sharding import PartitionSpec as P
+
+    ep = mesh.shape[expert_axis]
+    e = gate_w.shape[1]
+    if e % ep:
+        raise ValueError(f"num_experts {e} not divisible by expert-axis "
+                         f"size {ep}")
+
+    @partial(
+        shard_map, mesh=mesh,
+        in_specs=(P((data_axis, expert_axis), None), P(),
+                  P(expert_axis, None, None), P(expert_axis, None),
+                  P(expert_axis, None, None), P(expert_axis, None)),
+        out_specs=P((data_axis, expert_axis), None))
+    def fn(x_l, gw, w1_l, b1_l, w2_l, b2_l):
+        n_l, d = x_l.shape
+        kk = min(k, e)
+        cap = max(1, math.ceil(capacity_factor * kk * n_l / e))
+        logits = jnp.dot(x_l, gw)
+        probs = jax.nn.softmax(logits, axis=-1)
+        topv, topi = jax.lax.top_k(probs, kk)
+        gates = topv / jnp.maximum(jnp.sum(topv, -1, keepdims=True), 1e-9)
+        onehot_i = jax.nn.one_hot(topi, e, dtype=jnp.int32)
+        flat = onehot_i.reshape(n_l * kk, e)
+        pos = (jnp.cumsum(flat, axis=0) * flat - flat).reshape(n_l, kk, e)
+        keep = ((pos < cap) & (onehot_i > 0)).astype(x_l.dtype)
+        slot = jax.nn.one_hot(pos, cap, dtype=x_l.dtype)
+        disp_k = slot * keep[..., None]
+        dispatch = jnp.sum(disp_k, axis=1)                   # [n_l, E, C]
+        combine = jnp.sum(disp_k * gates.astype(x_l.dtype)[..., None, None],
+                          axis=1)
+
+        expert_in = jnp.einsum("nec,nd->ecd", dispatch, x_l)  # [E, C, D]
+        # all-to-all: split the expert dim over the expert axis, gather
+        # every peer's slots for MY experts along the capacity dim
+        recv = jax.lax.all_to_all(expert_in, expert_axis, split_axis=0,
+                                  concat_axis=1, tiled=True)  # [E/ep, ep*C, D]
+        h = jnp.einsum("ecd,edh->ech", recv, w1_l) + b1_l[:, None]
+        h = jax.nn.relu(h)
+        out = jnp.einsum("ech,ehd->ecd", h, w2_l) + b2_l[:, None]
+        # reverse all-to-all: send each peer its tokens' results back
+        back = jax.lax.all_to_all(out, expert_axis, split_axis=1,
+                                  concat_axis=0, tiled=True)  # [E, C, D]
+        return jnp.einsum("nec,ecd->nd", combine, back)
+
+    return fn(x, gate_w, w1, b1, w2, b2)
 
 
 def load_balance_loss(router_probs, num_experts: Optional[int] = None):

@@ -1,0 +1,546 @@
+"""Pipeline parallelism over Symbol stages (GPipe microbatch schedule).
+
+The reference pipelines a model-parallel LSTM by placing layers on
+different GPUs with ``ctx_group`` attrs and letting the dependency engine
+overlap timesteps (``example/model-parallel-lstm/lstm.py:48-205``).  The
+TPU-native equivalent here:
+
+* a Symbol is **partitioned into stages** — either by its ``ctx_group``
+  attrs (reference parity) or by an automatic contiguous cost balance;
+* each stage becomes its OWN compiled program pinned to its device
+  (MPMD, not SPMD) — stages may have **arbitrary, different shapes**;
+* the global batch is split into microbatches; the GPipe fill/drain
+  schedule emerges from JAX async dispatch exactly the way the
+  reference's engine pipelines timesteps: stage ``s`` of microbatch
+  ``j`` only depends on stage ``s-1`` of ``j`` and stage ``s`` of
+  ``j-1``, so all devices run concurrently — **no S× wasted compute**
+  (the old ``pipeline_apply`` ran every stage on every device and
+  psum-masked the result; it remains as the homogeneous-stage SPMD
+  fast path);
+* the backward pass **rematerializes** each stage's forward inside its
+  vjp (the original GPipe recipe) so only stage inputs are kept per
+  in-flight microbatch, then gradients accumulate across microbatches
+  and a per-stage optimizer update runs on the stage's device.
+
+Cross-stage tensors travel in an "env" dict keyed ``"node#out_idx"`` —
+skip connections that jump stages simply ride the env through the
+intermediate stages, and their cotangents accumulate automatically
+through the stage vjp.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..ops.registry import OpContext
+
+__all__ = ["PipelineTrainer"]
+
+
+def _node_cost(node, shape_of, input_names):
+    """Stage-balance weight: parameter elements feeding this op + 1
+    (batch inputs are data, not model capacity — excluded)."""
+    cost = 1.0
+    for src, _ in node.inputs:
+        if (src.is_variable and src.name in shape_of
+                and src.name not in input_names):
+            cost += float(np.prod(shape_of[src.name]))
+    return cost
+
+
+def _assign_stages(topo, num_stages, group2stage, shape_of, input_names):
+    """stage index per op node; variables follow their first consumer."""
+    op_nodes = [n for n in topo if not n.is_variable]
+    stage: Dict[int, int] = {}
+    if group2stage:
+        last = 0
+        for n in op_nodes:
+            g = n.anno_attrs().get("ctx_group")
+            if g is not None:
+                if g not in group2stage:
+                    raise MXNetError(f"node {n.name}: ctx_group {g!r} not in "
+                                     f"group2stage {sorted(group2stage)}")
+                last = int(group2stage[g])
+            stage[id(n)] = last
+    else:
+        costs = [_node_cost(n, shape_of, input_names) for n in op_nodes]
+        total = sum(costs)
+        target = total / num_stages
+        s, acc = 0, 0.0
+        for idx, (n, c) in enumerate(zip(op_nodes, costs)):
+            # midpoint rule: close the stage once adding half this node
+            # overshoots its share — but only while enough nodes remain
+            # to populate every later stage
+            can_close = (s < num_stages - 1
+                         and len(op_nodes) - idx > num_stages - 1 - s)
+            if acc > 0 and acc + c / 2 >= target and can_close:
+                s, acc = s + 1, 0.0
+            stage[id(n)] = s
+            acc += c
+    # monotonicity: a node must not run before a later-stage producer
+    for n in op_nodes:
+        for src, _ in n.inputs:
+            if not src.is_variable and stage[id(src)] > stage[id(n)]:
+                raise MXNetError(
+                    f"stage assignment not topological: {n.name} (stage "
+                    f"{stage[id(n)]}) consumes {src.name} (stage "
+                    f"{stage[id(src)]})")
+    return stage
+
+
+class PipelineTrainer:
+    """Train a Symbol split into pipeline stages across devices.
+
+    Parameters
+    ----------
+    symbol : Symbol
+        Heads must be loss outputs (as for ShardedTrainer).
+    num_stages : int
+        Number of pipeline stages (== devices used).
+    devices : sequence of jax.Device, optional
+        Defaults to ``jax.devices()[:num_stages]``.
+    group2stage : dict, optional
+        ``ctx_group`` attr value -> stage index (reference ``group2ctx``
+        parity).  Without it, stages are balanced automatically.
+    num_microbatches : int
+        GPipe microbatch count; global batch must divide by it.
+    """
+
+    def __init__(self, symbol, num_stages: int, devices=None,
+                 group2stage: Optional[Dict[str, int]] = None,
+                 optimizer="sgd", optimizer_params=None,
+                 num_microbatches: int = 4, initializer=None,
+                 compute_dtype: Optional[str] = None, logger=None):
+        from .. import optimizer as opt_mod
+        from ..initializer import Uniform
+        self.symbol = symbol
+        self.num_stages = int(num_stages)
+        self.devices = list(devices) if devices is not None else \
+            jax.devices()[:self.num_stages]
+        if len(self.devices) < self.num_stages:
+            raise MXNetError(f"need {self.num_stages} devices, have "
+                             f"{len(self.devices)}")
+        self.group2stage = group2stage
+        self.num_microbatches = int(num_microbatches)
+        if isinstance(optimizer, str):
+            optimizer = opt_mod.create(optimizer, **(optimizer_params or {}))
+        if type(optimizer)._needs_rng:
+            raise MXNetError("PipelineTrainer does not support stochastic "
+                             "optimizers (SGLD) yet")
+        self.optimizer = optimizer
+        self.initializer = initializer or Uniform(0.07)
+        self.compute_dtype = jnp.dtype(compute_dtype) if compute_dtype else None
+        self.logger = logger or logging.getLogger(__name__)
+        self._bound = False
+
+    # ------------------------------------------------------------------
+    # Bind
+    # ------------------------------------------------------------------
+
+    def bind(self, data_shapes, label_shapes=None, arg_params=None,
+             aux_params=None) -> "PipelineTrainer":
+        sym = self.symbol
+        input_shapes = dict(data_shapes)
+        input_shapes.update(label_shapes or {})
+        for name, shape in input_shapes.items():
+            if shape[0] % self.num_microbatches:
+                raise MXNetError(
+                    f"global batch {shape[0]} for {name!r} not divisible by "
+                    f"num_microbatches {self.num_microbatches}")
+        arg_names = sym.list_arguments()
+        self._input_names = [n for n in arg_names if n in input_shapes]
+        self._param_names = [n for n in arg_names if n not in input_shapes]
+        arg_shapes, _, aux_shapes = sym.infer_shape(**input_shapes)
+        if any(s is None for s in arg_shapes):
+            raise MXNetError("bind: incomplete shape inference")
+        shape_of = dict(zip(arg_names, arg_shapes))
+        self._input_shapes = {n: shape_of[n] for n in self._input_names}
+
+        topo = sym._topo()
+        self._topo = topo
+        self._gidx = {id(n): i for i, n in enumerate(topo)}
+        stage = _assign_stages(topo, self.num_stages, self.group2stage,
+                               shape_of, set(self._input_names))
+        self._stage_of = stage
+        used = sorted({s for s in stage.values()})
+        if len(used) < self.num_stages:
+            self.logger.warning("only %d of %d stages are populated",
+                                len(used), self.num_stages)
+
+        # per-stage node lists, variable ownership, env (boundary) keys
+        self._stage_nodes = [
+            [n for n in topo if not n.is_variable and stage[id(n)] == s]
+            for s in range(self.num_stages)]
+        var_stages: Dict[str, set] = {}
+        for n in topo:
+            if n.is_variable:
+                var_stages[n.name] = {
+                    stage[id(m)] for m in topo if not m.is_variable
+                    and any(src is n for src, _ in m.inputs)} or {0}
+        for nm in self._param_names:
+            if len(var_stages[nm]) > 1:
+                raise MXNetError(
+                    f"parameter {nm!r} is consumed by multiple pipeline "
+                    f"stages {sorted(var_stages[nm])}; tie weights within "
+                    f"one stage or pin the consumers to one ctx_group")
+        self._stage_params = [
+            [nm for nm in self._param_names if var_stages[nm] == {s}]
+            for s in range(self.num_stages)]
+        # batch inputs are injected at EVERY consuming stage (no grads
+        # flow to them, so duplication is free)
+        self._stage_inputs = [
+            [nm for nm in self._input_names if s in var_stages[nm]]
+            for s in range(self.num_stages)]
+        # aux states follow their node's stage
+        self._stage_aux: List[List[str]] = [[] for _ in range(self.num_stages)]
+        aux_names = sym.list_auxiliary_states()
+        aux_shape_of = dict(zip(aux_names, aux_shapes))
+        for n in topo:
+            if n.is_variable:
+                continue
+            for full in n.aux_full_names():
+                self._stage_aux[stage[id(n)]].append(full)
+
+        # env keys crossing each s -> s+1 edge: tensors produced at
+        # stage <= s and consumed (by an op or as a head) at stage > s
+        def key_of(node, i):
+            return f"{node.name}#{i}"
+
+        produced_at: Dict[str, int] = {}
+        consumed_at: Dict[str, int] = {}
+        for n in topo:
+            if n.is_variable:
+                continue
+            s = stage[id(n)]
+            nout = len(n.op.list_outputs(n.parsed_params()))
+            for i in range(nout):
+                produced_at[key_of(n, i)] = s
+            for src, i in n.inputs:
+                if not src.is_variable:
+                    k = key_of(src, i)
+                    consumed_at[k] = max(consumed_at.get(k, 0), s)
+        self._head_keys = []
+        for (hn, hi) in sym._heads:
+            k = key_of(hn, hi)
+            self._head_keys.append((k, stage[id(hn)]))
+        self._env_after = []  # env_after[s]: keys alive crossing s -> s+1
+        for s in range(self.num_stages - 1):
+            alive = sorted(
+                k for k, ps in produced_at.items()
+                if ps <= s and consumed_at.get(k, -1) > s)
+            self._env_after.append(alive)
+
+        # ---- init + place params/aux on stage devices ----------------
+        from ..ndarray import NDArray
+        from ..context import cpu
+        host = cpu()
+        self._params: List[Dict[str, jax.Array]] = []
+        self._aux: List[Dict[str, jax.Array]] = []
+        self._opt_state: List[Dict[str, Any]] = []
+        opt = self.optimizer
+        for s in range(self.num_stages):
+            dev = self.devices[s]
+            ps: Dict[str, jax.Array] = {}
+            for nm in self._stage_params[s]:
+                nd = NDArray(np.zeros(shape_of[nm], np.float32), ctx=host)
+                if arg_params and nm in arg_params:
+                    src = arg_params[nm]
+                    nd._write(jnp.asarray(src.data if isinstance(src, NDArray)
+                                          else src))
+                else:
+                    self.initializer(nm, nd)
+                ps[nm] = jax.device_put(nd.data, dev)
+            self._params.append(ps)
+            ax: Dict[str, jax.Array] = {}
+            for full in self._stage_aux[s]:
+                shp = aux_shape_of[full]
+                nd = NDArray(np.zeros(shp, np.float32), ctx=host)
+                if aux_params and full in aux_params:
+                    src = aux_params[full]
+                    nd._write(jnp.asarray(src.data if isinstance(src, NDArray)
+                                          else src))
+                else:
+                    self.initializer(full, nd)
+                ax[full] = jax.device_put(nd.data, dev)
+            self._aux.append(ax)
+            self._opt_state.append(
+                {nm: jax.tree.map(lambda z: jax.device_put(z, dev),
+                                  opt.state_zeros_like(ps[nm]))
+                 for nm in ps})
+
+        if getattr(opt, "_rescale_set", True):
+            self._rescale_grad = opt.rescale_grad
+        else:
+            batch0 = next(iter(data_shapes.values()))[0]
+            self._rescale_grad = 1.0 / float(batch0)
+        self._wd_mult = {n: (0.0 if n.endswith(("_gamma", "_beta", "_bias"))
+                             else 1.0) for n in self._param_names}
+        for n in self._param_names:
+            if n in opt.wd_mult:
+                self._wd_mult[n] = opt.wd_mult[n]
+        self._lr_mult = {n: opt.lr_mult.get(n, 1.0) for n in self._param_names}
+        self._num_update = opt.begin_num_update
+        self._compile()
+        self._bound = True
+        return self
+
+    # ------------------------------------------------------------------
+    # Per-stage programs
+    # ------------------------------------------------------------------
+
+    def _stage_apply(self, s, params_s, aux_s, env_in, inputs_s, rng,
+                     is_train):
+        """Evaluate stage s's nodes; returns (env_out, heads_s, aux_up)."""
+        cdt = self.compute_dtype
+        vals: Dict[tuple, jax.Array] = {}
+        env = dict(env_in)
+        aux_up: Dict[str, jax.Array] = {}
+        heads_s: List[jax.Array] = []
+
+        def cast(v):
+            return (v.astype(cdt)
+                    if cdt is not None and v.dtype == jnp.float32 else v)
+
+        for node in self._stage_nodes[s]:
+            op = node.op
+            p = node.parsed_params()
+            in_vals = []
+            for src, i in node.inputs:
+                if src.is_variable:
+                    if src.name in params_s:
+                        in_vals.append(cast(params_s[src.name]))
+                    else:
+                        in_vals.append(inputs_s[src.name])
+                elif (id(src), i) in vals:
+                    in_vals.append(vals[(id(src), i)])
+                else:
+                    in_vals.append(env[f"{src.name}#{i}"])
+            short = op.list_aux_states(p)
+            fulls = node.aux_full_names()
+            aux = {sh: aux_s[f] for sh, f in zip(short, fulls)}
+            node_rng = (jax.random.fold_in(rng, self._gidx[id(node)])
+                        if rng is not None else None)
+            opctx = OpContext(is_train=is_train, rng=node_rng, aux=aux,
+                              name=node.name)
+            out = op.forward(opctx, p, *in_vals)
+            outs = list(out) if isinstance(out, (tuple, list)) else [out]
+            for i, o in enumerate(outs):
+                vals[(id(node), i)] = o
+            for sh, f in zip(short, fulls):
+                if sh in opctx.aux_updates:
+                    aux_up[f] = opctx.aux_updates[sh]
+        # harvest heads produced at this stage
+        for (k, hs) in self._head_keys:
+            if hs == s:
+                name, i = k.rsplit("#", 1)
+                node = next(n for n in self._stage_nodes[s]
+                            if n.name == name)
+                heads_s.append(vals[(id(node), int(i))])
+        # env crossing to the next stage
+        env_out = {}
+        if s < self.num_stages - 1:
+            for k in self._env_after[s]:
+                if k in env:
+                    env_out[k] = env[k]
+                else:
+                    name, i = k.rsplit("#", 1)
+                    node = next(n for n in self._stage_nodes[s]
+                                if n.name == name)
+                    env_out[k] = vals[(id(node), int(i))]
+        return env_out, tuple(heads_s), aux_up
+
+    def _compile(self):
+        opt = self.optimizer
+        hyper = opt._hyper()
+        hyper["rescale_grad"] = self._rescale_grad
+        step_fn = type(opt)._functional_step
+        self._fwd = []
+        self._bwd = []
+        self._upd = []
+        for s in range(self.num_stages):
+            def fwd(params_s, aux_s, env_in, inputs_s, rng, _s=s):
+                return self._stage_apply(_s, params_s, aux_s, env_in,
+                                         inputs_s, rng, True)
+
+            def bwd(params_s, aux_s, env_in, inputs_s, rng, ct_env, _s=s):
+                # rematerialized vjp (GPipe): re-run the stage forward
+                # inside the vjp; only (env_in, inputs) were kept alive.
+                # Loss heads ignore their cotangent (custom_vjp), so the
+                # head seed is just ones, built abstractly here.
+                def f(p, e):
+                    env_out, heads, _ = self._stage_apply(
+                        _s, p, aux_s, e, inputs_s, rng, True)
+                    return env_out, heads
+                shapes = jax.eval_shape(f, params_s, env_in)
+                ct_heads = tuple(jnp.ones(x.shape, x.dtype)
+                                 for x in shapes[1])
+                _, vjp_fn = jax.vjp(f, params_s, env_in)
+                gp, genv = vjp_fn((ct_env, ct_heads))
+                return gp, genv
+
+            def upd(params_s, grads_s, opt_s, lr, t, _s=s):
+                new_p, new_o = {}, {}
+                for nm in sorted(params_s):
+                    w2, st2 = step_fn(hyper, params_s[nm], grads_s[nm],
+                                      opt_s[nm], lr * self._lr_mult[nm],
+                                      opt.wd * self._wd_mult[nm], t, None)
+                    new_p[nm] = w2
+                    new_o[nm] = st2
+                return new_p, new_o
+
+            self._fwd.append(jax.jit(fwd))
+            self._bwd.append(jax.jit(bwd))
+            self._upd.append(jax.jit(upd))
+        self._eval = [jax.jit(
+            lambda p, a, e, i, r, _s=s: self._stage_apply(_s, p, a, e, i, r,
+                                                          False))
+            for s in range(self.num_stages)]
+
+    # ------------------------------------------------------------------
+    # Step
+    # ------------------------------------------------------------------
+
+    def _split_micro(self, batch) -> List[List[Dict[str, jax.Array]]]:
+        """Per-stage, per-microbatch input dicts placed on stage devices."""
+        if hasattr(batch, "data"):
+            vals = list(batch.data) + list(batch.label or [])
+            named = dict(zip(self._input_names, vals))
+        elif isinstance(batch, dict):
+            named = batch
+        else:
+            named = dict(zip(self._input_names, batch))
+        M = self.num_microbatches
+        out = []
+        for s in range(self.num_stages):
+            per_mb = []
+            for j in range(M):
+                d = {}
+                for nm in self._stage_inputs[s]:
+                    v = named[nm]
+                    v = v.data if hasattr(v, "data") else v
+                    v = np.asarray(v)
+                    mb = v.shape[0] // M
+                    d[nm] = jax.device_put(v[j * mb:(j + 1) * mb],
+                                           self.devices[s])
+                per_mb.append(d)
+            out.append(per_mb)
+        return out
+
+    def step(self, batch) -> List[jax.Array]:
+        """One pipelined training step; returns heads concatenated over
+        microbatches (on the producing stage's device)."""
+        if not self._bound:
+            raise MXNetError("call bind() before step()")
+        self._num_update += 1
+        opt = self.optimizer
+        lr = np.float32(opt.lr_scheduler(self._num_update)
+                        if opt.lr_scheduler else opt.lr)
+        t = np.int32(self._num_update)
+        M = self.num_microbatches
+        S = self.num_stages
+        inputs = self._split_micro(batch)
+        rngs = self._make_rngs(M)
+
+        # ---- forward wavefront (async dispatch = GPipe fill): stage s
+        # of microbatch j depends only on (s-1, j) and — through the
+        # device — (s, j-1), so all S devices run concurrently ----------
+        envs = [[None] * S for _ in range(M)]  # env entering stage s
+        heads_js = [[None] * S for _ in range(M)]
+        aux = [dict(a) for a in self._aux]
+        for j in range(M):
+            env: Dict[str, jax.Array] = {}
+            for s in range(S):
+                env = {k: jax.device_put(v, self.devices[s])
+                       for k, v in env.items()}
+                envs[j][s] = env
+                env, heads_s, aux_up = self._fwd[s](
+                    self._params[s], aux[s], env, inputs[s][j], rngs[j][s])
+                if aux_up:
+                    aux[s] = dict(aux[s], **aux_up)
+                heads_js[j][s] = heads_s
+
+        # ---- backward wavefront (drain, reverse order) ----------------
+        grads: List[Optional[Dict[str, jax.Array]]] = [None] * S
+        for j in range(M):
+            ct_env: Dict[str, jax.Array] = {}
+            for s in range(S - 1, -1, -1):
+                ct_env = {k: jax.device_put(v, self.devices[s])
+                          for k, v in ct_env.items()}
+                gp, genv = self._bwd[s](
+                    self._params[s], aux[s], envs[j][s], inputs[s][j],
+                    rngs[j][s], ct_env)
+                ct_env = genv
+                if grads[s] is None:
+                    grads[s] = gp
+                else:
+                    grads[s] = jax.tree.map(jnp.add, grads[s], gp)
+
+        # ---- per-stage optimizer update -------------------------------
+        for s in range(S):
+            if not self._params[s]:
+                continue
+            self._params[s], self._opt_state[s] = self._upd[s](
+                self._params[s], grads[s], self._opt_state[s], lr, t)
+        self._aux = aux
+        return self._gather_heads(heads_js)
+
+    def _make_rngs(self, M):
+        """Per-(microbatch, stage) rng keys placed on stage devices."""
+        keys = []
+        for j in range(M):
+            kj = np.asarray(jax.random.fold_in(
+                jax.random.PRNGKey(self._num_update), j))
+            keys.append([jax.device_put(kj, d) for d in self.devices])
+        return keys
+
+    def _gather_heads(self, heads_js):
+        """Concatenate per-microbatch heads back to symbol head order."""
+        M = self.num_microbatches
+        outs = []
+        # heads within one stage were harvested in _head_keys order, so
+        # count per-stage positions to recover the global ordering
+        pos_in_stage: Dict[int, int] = {}
+        for (k, hs) in self._head_keys:
+            i = pos_in_stage.get(hs, 0)
+            pos_in_stage[hs] = i + 1
+            outs.append(jnp.concatenate(
+                [heads_js[j][hs][i] for j in range(M)], axis=0))
+        return outs
+
+    def forward(self, batch) -> List[jax.Array]:
+        if not self._bound:
+            raise MXNetError("call bind() before forward()")
+        inputs = self._split_micro(batch)
+        M, S = self.num_microbatches, self.num_stages
+        heads_js = [[None] * S for _ in range(M)]
+        rngs = self._make_rngs(M)
+        for j in range(M):
+            env: Dict[str, jax.Array] = {}
+            for s in range(S):
+                env = {k: jax.device_put(v, self.devices[s])
+                       for k, v in env.items()}
+                env, heads_s, _ = self._eval[s](
+                    self._params[s], self._aux[s], env, inputs[s][j],
+                    rngs[j][s])
+                heads_js[j][s] = heads_s
+        return self._gather_heads(heads_js)
+
+    # ------------------------------------------------------------------
+
+    def get_params(self):
+        from ..ndarray import array as nd_array
+        arg = {}
+        for ps in self._params:
+            for n, v in ps.items():
+                arg[n] = nd_array(np.asarray(v))
+        aux = {}
+        for ax in self._aux:
+            for n, v in ax.items():
+                aux[n] = nd_array(np.asarray(v))
+        return arg, aux

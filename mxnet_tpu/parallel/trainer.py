@@ -157,6 +157,23 @@ class ShardedTrainer:
                               if compute_dtype else None)
         self._bound = False
 
+    def _multiproc(self) -> bool:
+        if not hasattr(self, "_multiproc_cached"):
+            self._multiproc_cached = any(
+                d.process_index != jax.process_index()
+                for d in self.mesh.devices.flat)
+        return self._multiproc_cached
+
+    def _global_put(self, val, sh):
+        """Place a host value under ``sh``; on a multi-host mesh each
+        process materializes only its addressable shards (params must be
+        initialized identically on every process — same seed)."""
+        if self._multiproc():
+            val = np.asarray(val)
+            return jax.make_array_from_callback(
+                val.shape, sh, lambda idx: val[idx])
+        return jax.device_put(val, sh)
+
     def _precision_scope(self):
         import contextlib
         if self.matmul_precision is None:
@@ -205,7 +222,7 @@ class ShardedTrainer:
                                       else src))
             else:
                 self.initializer(n, nd)
-            params[n] = jax.device_put(
+            params[n] = self._global_put(
                 nd.data, NamedSharding(self.mesh, self.rules.spec_for(n)))
         aux: Dict[str, jax.Array] = {}
         for n, s in zip(self._aux_names, aux_shapes):
@@ -216,7 +233,7 @@ class ShardedTrainer:
                                       else src))
             else:
                 self.initializer(n, nd)
-            aux[n] = jax.device_put(nd.data, replicated(self.mesh))
+            aux[n] = self._global_put(nd.data, replicated(self.mesh))
 
         opt = self.optimizer
         # loss-head gradients are per-sample (summed into weight grads), so
@@ -232,7 +249,7 @@ class ShardedTrainer:
         self._zero_specs = {n: self._zero_spec(n, shape_of[n])
                             for n in self._param_names}
         opt_state = {n: jax.tree.map(
-            lambda z, _n=n: jax.device_put(
+            lambda z, _n=n: self._global_put(
                 z, NamedSharding(self.mesh, self._zero_specs[_n])),
             opt.state_zeros_like(params[n])) for n in self._param_names}
 
@@ -383,11 +400,19 @@ class ShardedTrainer:
             named = batch
         else:
             named = dict(zip(self._input_names, batch))
+        multiproc = self._multiproc()
         out = {}
         for n in self._input_names:
             v = named[n]
             v = v.data if isinstance(v, NDArray) else jnp.asarray(v)
-            out[n] = jax.device_put(v, sh)
+            if multiproc:
+                # pod case: every process feeds ITS shard of the global
+                # batch (dim 0 = this host's rows); assembled into one
+                # global array without cross-host data movement
+                out[n] = jax.make_array_from_process_local_data(
+                    sh, np.asarray(v))
+            else:
+                out[n] = jax.device_put(v, sh)
         return out
 
     def step(self, batch) -> List[jax.Array]:
@@ -437,12 +462,12 @@ class ShardedTrainer:
         for n, v in (arg_params or {}).items():
             if n in self._params:
                 val = v.data if isinstance(v, NDArray) else jnp.asarray(v)
-                self._params[n] = jax.device_put(
+                self._params[n] = self._global_put(
                     val, NamedSharding(self.mesh, self.rules.spec_for(n)))
         for n, v in (aux_params or {}).items():
             if n in self._aux:
                 val = v.data if isinstance(v, NDArray) else jnp.asarray(v)
-                self._aux[n] = jax.device_put(val, replicated(self.mesh))
+                self._aux[n] = self._global_put(val, replicated(self.mesh))
 
     def score(self, eval_data, eval_metric):
         from ..metric import create as metric_create
@@ -476,12 +501,12 @@ class ShardedTrainer:
             # the ceil fallback below is approximate for custom iterators
             # — use optimizer.begin_num_update for exact resume there
             batches = getattr(train_data, "steps_per_epoch", None)
-            if not batches:
+            if batches is None:  # 0 is authoritative (empty shard)
                 nd_ = getattr(train_data, "num_data", None)
                 bs = getattr(train_data, "batch_size", None)
                 if nd_ and bs:
                     batches = -(-nd_ // bs)
-            if batches:
+            if batches is not None:
                 self._num_update += begin_epoch * int(batches)
             else:
                 self.logger.warning(

@@ -17,7 +17,10 @@ from .ndarray import NDArray, imperative_invoke
 
 __all__ = ["seed", "uniform", "normal", "randn"]
 
-_state = {"key": jax.random.PRNGKey(0)}
+# lazy: materializing a PRNGKey initializes the XLA backend, which must
+# not happen at import time (jax.distributed.initialize comes first on
+# multi-host pods)
+_state = {"key": None}
 
 
 def seed(seed_state: int) -> None:
@@ -26,6 +29,8 @@ def seed(seed_state: int) -> None:
 
 
 def _next_key():
+    if _state["key"] is None:
+        _state["key"] = jax.random.PRNGKey(0)
     _state["key"], sub = jax.random.split(_state["key"])
     return sub
 

@@ -1,0 +1,143 @@
+"""CTC loss: brute-force path-sum equivalence, finite-difference grads,
+WarpCTC op head semantics (reference plugin/warpctc parity)."""
+import itertools
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu.ops.ctc import ctc_loss
+from mxnet_tpu.ops.registry import get_op, OpContext
+
+
+def _brute_force_nll(log_probs, label, blank=0):
+    """Sum over ALL alignments pi of prod_t p[t, pi_t] with collapse(pi)
+    == label.  Exponential — only for tiny T/C."""
+    T, C = log_probs.shape
+    total = 0.0
+    for path in itertools.product(range(C), repeat=T):
+        # collapse: remove repeats then blanks
+        prev, out = None, []
+        for s in path:
+            if s != prev:
+                if s != blank:
+                    out.append(s)
+            prev = s
+        if out == list(label):
+            total += np.exp(sum(log_probs[t, s] for t, s in enumerate(path)))
+    return -np.log(total) if total > 0 else np.inf
+
+
+def test_ctc_matches_brute_force():
+    rng = np.random.RandomState(0)
+    T, B, C = 5, 3, 4
+    logits = rng.randn(T, B, C).astype(np.float32)
+    labels = np.array([[1, 2], [3, 0], [2, 2]], np.int32)  # lens 2, 1, 2
+    losses = np.asarray(ctc_loss(jnp.asarray(logits), jnp.asarray(labels)))
+    lp = np.asarray(jax.nn.log_softmax(jnp.asarray(logits), axis=-1))
+    for b in range(B):
+        lab = [v for v in labels[b] if v != 0]
+        ref = _brute_force_nll(lp[:, b], lab)
+        np.testing.assert_allclose(losses[b], ref, rtol=1e-5,
+                                   err_msg=f"sample {b} label {lab}")
+
+
+def test_ctc_gradient_finite_difference():
+    rng = np.random.RandomState(1)
+    T, B, C = 4, 2, 3
+    logits = rng.randn(T, B, C).astype(np.float64)
+    labels = jnp.asarray([[1, 2], [2, 0]], jnp.int32)
+
+    def total(lg):
+        return jnp.sum(ctc_loss(lg, labels))
+
+    g = np.asarray(jax.grad(total)(jnp.asarray(logits)))
+    eps = 1e-5
+    for _ in range(10):
+        t, b, c = rng.randint(T), rng.randint(B), rng.randint(C)
+        lp = logits.copy(); lp[t, b, c] += eps
+        lm = logits.copy(); lm[t, b, c] -= eps
+        num = (float(total(jnp.asarray(lp))) - float(total(jnp.asarray(lm)))) \
+            / (2 * eps)
+        np.testing.assert_allclose(g[t, b, c], num, rtol=1e-3, atol=1e-6)
+
+
+def test_ctc_impossible_label_is_inf():
+    # T=1 cannot emit a 2-symbol label
+    logits = jnp.zeros((1, 1, 4))
+    loss = ctc_loss(logits, jnp.asarray([[1, 2]], jnp.int32))
+    assert float(loss[0]) > 1e9
+
+
+def test_warpctc_op_head():
+    """Op-level parity: softmax forward, CTC grad backward, grad ignores
+    the head cotangent (loss-head semantics)."""
+    rng = np.random.RandomState(2)
+    T, B, C, L = 6, 2, 5, 3
+    op = get_op("WarpCTC")
+    p = op.parse_params({"input_length": T, "label_length": L})
+    data = jnp.asarray(rng.randn(T * B, C).astype(np.float32))
+    label = jnp.asarray(
+        np.array([[1, 2, 1], [3, 0, 0]], np.float32).reshape(-1))
+    out = op.forward(OpContext(), p, data, label)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(jax.nn.softmax(data, axis=-1)),
+        rtol=1e-6)
+
+    # backward: vjp with an arbitrary cotangent equals the CTC gradient
+    fwd = lambda d: op.forward(OpContext(), p, d, label)
+    _, vjp = jax.vjp(fwd, data)
+    (g,) = vjp(jnp.full((T * B, C), 7.0, jnp.float32))  # ct ignored
+
+    logits = data.reshape(T, B, C)
+    labels = label.astype(jnp.int32).reshape(B, L)
+    g_ref = jax.grad(lambda lg: jnp.sum(ctc_loss(lg, labels)))(logits)
+    np.testing.assert_allclose(np.asarray(g),
+                               np.asarray(g_ref).reshape(T * B, C),
+                               rtol=1e-5, atol=1e-7)
+
+
+def test_warpctc_symbol_training():
+    """A tiny recurrent-free 'OCR' net trains through the WarpCTC head."""
+    T, B, C, L = 8, 8, 11, 4
+    data = mx.symbol.Variable("data")          # [T*B, F]
+    net = mx.symbol.FullyConnected(data=data, num_hidden=32, name="fc1")
+    net = mx.symbol.Activation(data=net, act_type="relu")
+    net = mx.symbol.FullyConnected(data=net, num_hidden=C, name="fc2")
+    net = mx.symbol.WarpCTC(data=net, label=mx.symbol.Variable("label"),
+                            input_length=T, label_length=L, name="ctc")
+    import jax as _jax
+    from mxnet_tpu.parallel import ShardedTrainer, make_mesh
+    tr = ShardedTrainer(net, mesh=make_mesh({"data": 1},
+                                            [_jax.devices()[0]]),
+                        optimizer="sgd",
+                        optimizer_params={"learning_rate": 2.0,
+                                          "momentum": 0.9})
+    tr.bind(data_shapes={"data": (T * B, 16)},
+            label_shapes={"label": (B * L,)})
+    rng = np.random.RandomState(3)
+    # fixed batch: 4 digits per sample, frame t shows digit t//2's code;
+    # the CTC loss on it must collapse under training
+    digits = rng.randint(1, C, (B, L))
+    x = np.zeros((T, B, 16), np.float32)
+    for b in range(B):
+        for t in range(T):
+            x[t, b, digits[b, t // 2] % 16] = 1.0
+
+    def eval_loss():
+        probs = np.asarray(tr.forward(
+            {"data": x.reshape(T * B, 16),
+             "label": digits.astype(np.float32).reshape(-1)})[0])
+        logits = np.log(np.maximum(probs, 1e-9)).reshape(T, B, C)
+        return float(np.mean(np.asarray(ctc_loss(jnp.asarray(logits),
+                                                 jnp.asarray(digits)))))
+
+    before = eval_loss()
+    for _ in range(50):
+        tr.step({"data": x.reshape(T * B, 16),
+                 "label": digits.astype(np.float32).reshape(-1)})
+    after = eval_loss()
+    assert after < 0.1 * before, (before, after)

@@ -38,3 +38,36 @@ def test_dist_training_convergence():
     code = launch_local([sys.executable, script], num_workers=2,
                         num_servers=1, root_port=19477, timeout=300)
     assert code == 0
+
+
+def test_priority_sender_ordering_and_async():
+    """Sender drains by priority (higher first, reference -param_index
+    convention) and submit() returns before the work runs."""
+    import threading
+    import time as _time
+    from mxnet_tpu.parallel.dist_kvstore import _PrioritySender
+
+    s = _PrioritySender("t")
+    order = []
+    gate = threading.Event()
+    # block the queue so later submissions can reorder behind the gate
+    s.submit(100, gate.wait)
+    t0 = _time.perf_counter()
+    for prio in (0, -3, -1, -2):
+        s.submit(prio, lambda p=prio: order.append(p))
+    submit_cost = _time.perf_counter() - t0
+    assert submit_cost < 0.1, "submit must not block on the queued work"
+    gate.set()
+    s.flush()
+    assert order == [0, -1, -2, -3], order
+    s.close()
+
+
+def test_priority_sender_error_surfaces_at_flush():
+    from mxnet_tpu.parallel.dist_kvstore import _PrioritySender
+
+    s = _PrioritySender("err")
+    s.submit(0, lambda: (_ for _ in ()).throw(RuntimeError("boom")))
+    with pytest.raises(RuntimeError, match="boom"):
+        s.flush()
+    s.close()

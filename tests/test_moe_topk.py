@@ -1,0 +1,120 @@
+"""Top-k MoE routing + explicit expert-parallel all-to-all evidence.
+
+VERDICT round-2 item 10: top-2 routing with capacity, and an HLO
+inspection showing the expert all-to-all actually materializes on the
+sharded mesh.
+"""
+import re
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from mxnet_tpu.parallel.moe import moe_ffn, moe_ffn_ep
+
+
+def _params(E=4, D=8, H=16, seed=0):
+    rng = np.random.RandomState(seed)
+    return (jnp.asarray(rng.randn(D, E).astype(np.float32) * 0.5),
+            jnp.asarray(rng.randn(E, D, H).astype(np.float32) * 0.3),
+            jnp.asarray(rng.randn(E, H).astype(np.float32) * 0.1),
+            jnp.asarray(rng.randn(E, H, D).astype(np.float32) * 0.3),
+            jnp.asarray(rng.randn(E, D).astype(np.float32) * 0.1))
+
+
+def test_top2_matches_dense_expert_sum():
+    """With capacity large enough to drop nothing, top-2 output equals
+    sum_r gate_r * FFN_{expert_r}(x) with renormalized gates."""
+    E, D, H, N = 4, 8, 16, 32
+    gw, w1, b1, w2, b2 = _params(E, D, H)
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(N, D).astype(np.float32))
+    y, probs = moe_ffn(x, gw, w1, b1, w2, b2, k=2, capacity_factor=16.0)
+
+    pr = np.asarray(probs)
+    topi = np.argsort(-pr, axis=1)[:, :2]
+    xn = np.asarray(x)
+    expect = np.zeros((N, D), np.float32)
+    for n in range(N):
+        g = pr[n, topi[n]]
+        g = g / g.sum()
+        for r in range(2):
+            e = topi[n, r]
+            h = np.maximum(xn[n] @ np.asarray(w1)[e] + np.asarray(b1)[e], 0)
+            expect[n] += g[r] * (h @ np.asarray(w2)[e] + np.asarray(b2)[e])
+    np.testing.assert_allclose(np.asarray(y), expect, rtol=2e-5, atol=2e-6)
+
+
+def test_topk_capacity_drops_overflow():
+    """cap=1: each expert serves one assignment; later tokens routed to a
+    full expert lose that assignment's contribution."""
+    E, D, H, N = 2, 4, 8, 6
+    gw, w1, b1, w2, b2 = _params(E, D, H, seed=2)
+    rng = np.random.RandomState(3)
+    x = jnp.asarray(rng.randn(N, D).astype(np.float32))
+    # capacity_factor tiny -> cap = ceil(cf*k*N/E) = 1
+    y_small, _ = moe_ffn(x, gw, w1, b1, w2, b2, k=2,
+                         capacity_factor=1.0 / (2 * N))
+    y_big, _ = moe_ffn(x, gw, w1, b1, w2, b2, k=2, capacity_factor=16.0)
+    # overflow must change (reduce) some outputs
+    assert not np.allclose(np.asarray(y_small), np.asarray(y_big))
+    # token 0's rank-0 assignment always fits: its output is nonzero
+    assert np.abs(np.asarray(y_small)[0]).max() > 0
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8-device mesh")
+def test_ep_all_to_all_materializes_and_matches_dense():
+    mesh = Mesh(np.array(jax.devices()).reshape(2, 4), ("data", "expert"))
+    E, D, H, N = 4, 8, 16, 64
+    gw, w1, b1, w2, b2 = _params(E, D, H, seed=4)
+    rng = np.random.RandomState(5)
+    xh = rng.randn(N, D).astype(np.float32)
+    x = jax.device_put(xh, NamedSharding(mesh, P(("data", "expert"), None)))
+    place = lambda a, spec: jax.device_put(a, NamedSharding(mesh, spec))
+    gw_ = place(gw, P())
+    w1_ = place(w1, P("expert", None, None))
+    b1_ = place(b1, P("expert", None))
+    w2_ = place(w2, P("expert", None, None))
+    b2_ = place(b2, P("expert", None))
+
+    f = jax.jit(lambda *a: moe_ffn_ep(*a, mesh=mesh, k=2,
+                                      capacity_factor=8.0))
+    hlo = f.lower(x, gw_, w1_, b1_, w2_, b2_).compile().as_text()
+    assert re.search(r"all-to-all", hlo), \
+        "expert all-to-all missing from compiled HLO"
+    y_ep = np.asarray(f(x, gw_, w1_, b1_, w2_, b2_))
+    y_dense = np.asarray(moe_ffn(jnp.asarray(xh), gw, w1, b1, w2, b2,
+                                 k=2, capacity_factor=8.0)[0])
+    np.testing.assert_allclose(y_ep, y_dense, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8-device mesh")
+def test_ep_gradients_flow():
+    """Training-style vjp through the all-to-all path: finite grads for
+    every expert weight, psum-accumulated over the data axis."""
+    mesh = Mesh(np.array(jax.devices()).reshape(2, 4), ("data", "expert"))
+    E, D, H, N = 4, 8, 16, 64
+    gw, w1, b1, w2, b2 = _params(E, D, H, seed=6)
+    rng = np.random.RandomState(7)
+    x = jax.device_put(rng.randn(N, D).astype(np.float32),
+                       NamedSharding(mesh, P(("data", "expert"), None)))
+    place = lambda a, spec: jax.device_put(a, NamedSharding(mesh, spec))
+    params = (place(gw, P()), place(w1, P("expert", None, None)),
+              place(b1, P("expert", None)),
+              place(w2, P("expert", None, None)),
+              place(b2, P("expert", None)))
+
+    @jax.jit
+    def loss(params, x):
+        y = moe_ffn_ep(x, *params, mesh=mesh, k=2, capacity_factor=8.0)
+        return jnp.sum(jnp.square(y))
+
+    grads = jax.jit(jax.grad(loss))(params, x)
+    for g in jax.tree.leaves(grads):
+        assert np.all(np.isfinite(np.asarray(g)))
+    # expert up-projection must receive signal for every expert
+    g_w1 = np.asarray(grads[1])
+    assert np.all(np.abs(g_w1).reshape(E, -1).max(axis=1) > 0)
