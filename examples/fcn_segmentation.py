@@ -1,0 +1,160 @@
+"""FCN-style dense prediction + ROI pooling (reference ``example/fcn-xs``
+and ``example/rcnn`` story).
+
+Exercises the dynamic-shape executor path the detection examples need:
+a fully-convolutional net whose score map is bilinearly ``UpSampling``-ed
+and ``Crop``-ped back to the input size for per-pixel softmax
+(``multi_output``), trained on synthetic segmentation; then the SAME
+trained features are re-bound at a DIFFERENT input resolution (the FCN
+trick — conv weights are resolution-agnostic, each shape is one more
+compiled executor) and an ``ROIPooling`` head pools proposal boxes from
+the feature map (the rcnn flow).
+
+Run:  python examples/fcn_segmentation.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+
+NUM_CLASSES = 3
+
+
+def fcn_symbol():
+    """conv -> pool(/2) -> conv -> score -> 2x upsample -> crop -> pixel
+    softmax.  All sizes inferred from `data`, nothing hard-coded."""
+    data = sym.Variable("data")
+    net = sym.Convolution(data=data, num_filter=16, kernel=(3, 3),
+                          pad=(1, 1), name="conv1")
+    net = sym.Activation(data=net, act_type="relu")
+    net = sym.Pooling(data=net, pool_type="max", kernel=(2, 2),
+                      stride=(2, 2), name="pool1")
+    net = sym.Convolution(data=net, num_filter=16, kernel=(3, 3),
+                          pad=(1, 1), name="conv2")
+    net = sym.Activation(data=net, act_type="relu")
+    score = sym.Convolution(data=net, num_filter=NUM_CLASSES,
+                            kernel=(1, 1), name="score")
+    up = sym.UpSampling(score, scale=2, sample_type="bilinear",
+                        num_filter=NUM_CLASSES, name="upsample")
+    up = sym.Crop(up, data, name="crop")      # match input H, W exactly
+    return sym.SoftmaxOutput(data=up, multi_output=True,
+                             normalization="valid", name="softmax")
+
+
+def feature_symbol():
+    """The shared convolutional trunk, reused by the ROI head."""
+    data = sym.Variable("data")
+    net = sym.Convolution(data=data, num_filter=16, kernel=(3, 3),
+                          pad=(1, 1), name="conv1")
+    net = sym.Activation(data=net, act_type="relu")
+    net = sym.Pooling(data=net, pool_type="max", kernel=(2, 2),
+                      stride=(2, 2), name="pool1")
+    net = sym.Convolution(data=net, num_filter=16, kernel=(3, 3),
+                          pad=(1, 1), name="conv2")
+    return sym.Activation(data=net, act_type="relu")
+
+
+def roi_head():
+    """ROIPooling over trunk features (the rcnn flow: one proposal set
+    per image, 7x7 pooled regions -> per-ROI class scores)."""
+    feat = feature_symbol()
+    rois = sym.Variable("rois")               # [R, 5] (batch_idx, x1..y2)
+    pooled = sym.ROIPooling(data=feat, rois=rois, pooled_size=(7, 7),
+                            spatial_scale=0.5, name="roipool")
+    flat = sym.Flatten(data=pooled)
+    fc = sym.FullyConnected(data=flat, num_hidden=NUM_CLASSES, name="cls")
+    return sym.SoftmaxOutput(data=fc, name="roi_softmax")
+
+
+def make_batch(rng, b, hw):
+    """Synthetic segmentation: background 0, one bright class-k square."""
+    h = w = hw
+    x = rng.rand(b, 3, h, w).astype(np.float32) * 0.2
+    y = np.zeros((b, h, w), np.float32)
+    boxes = []
+    for i in range(b):
+        k = rng.randint(1, NUM_CLASSES)
+        size = h // 2
+        r, c = rng.randint(0, h - size), rng.randint(0, w - size)
+        x[i, :, r:r + size, c:c + size] += 0.4 * k
+        y[i, r:r + size, c:c + size] = k
+        boxes.append([i, c, r, c + size - 1, r + size - 1])
+    return x, y, np.asarray(boxes, np.float32)
+
+
+def main():
+    import jax
+    from mxnet_tpu.parallel import ShardedTrainer, make_mesh
+    rng = np.random.RandomState(0)
+    b, hw = 8, 24
+
+    # ---- dense FCN training at 24x24 ------------------------------
+    net = fcn_symbol()
+    tr = ShardedTrainer(net, mesh=make_mesh({"data": 1},
+                                            [jax.devices()[0]]),
+                        optimizer="sgd",
+                        # normalization="valid" makes the per-pixel loss a
+                        # mean, so plain lr + rescale_grad=1 are stable
+                        optimizer_params={"learning_rate": 0.5,
+                                          "momentum": 0.9,
+                                          "rescale_grad": 1.0})
+    tr.bind(data_shapes={"data": (b, 3, hw, hw)},
+            label_shapes={"softmax_label": (b, hw, hw)})
+    for step in range(250):
+        x, y, _ = make_batch(rng, b, hw)
+        out = tr.step({"data": x, "softmax_label": y})
+        if (step + 1) % 50 == 0:
+            pred = np.asarray(out[0]).argmax(1)
+            acc = float((pred == y).mean())
+            print(f"step {step+1}: pixel-acc {acc:.3f}")
+    assert acc > 0.85, f"FCN did not converge: {acc}"
+
+    # ---- SAME weights, different resolution (the fcn-xs dynamic-
+    # shape story: rebind per input size, conv weights shape-agnostic)
+    arg_p, aux_p = tr.get_params()
+    hw2 = 32
+    tr2 = ShardedTrainer(net, mesh=make_mesh({"data": 1},
+                                             [jax.devices()[0]]),
+                         optimizer="sgd",
+                         optimizer_params={"learning_rate": 0.0})
+    tr2.bind(data_shapes={"data": (b, 3, hw2, hw2)},
+             label_shapes={"softmax_label": (b, hw2, hw2)},
+             arg_params=arg_p, aux_params=aux_p)
+    x2, y2, _ = make_batch(rng, b, hw2)
+    pred2 = np.asarray(tr2.forward(
+        {"data": x2, "softmax_label": y2})[0]).argmax(1)
+    acc2 = float((pred2 == y2).mean())
+    print(f"rebound at {hw2}x{hw2}: pixel-acc {acc2:.3f}")
+    assert acc2 > 0.75, f"resolution transfer failed: {acc2}"
+
+    # ---- ROI head over the trained trunk (rcnn flow) ----------------
+    roi = roi_head()
+    R = b
+    tr3 = ShardedTrainer(roi, mesh=make_mesh({"data": 1},
+                                             [jax.devices()[0]]),
+                         data_axis=None,  # rois dim0 != data dim0
+                         optimizer="adam",
+                         optimizer_params={"learning_rate": 0.005})
+    tr3.bind(data_shapes={"data": (b, 3, hw, hw), "rois": (R, 5)},
+             label_shapes={"roi_softmax_label": (R,)},
+             arg_params=arg_p)
+    for step in range(175):
+        x, y, boxes = make_batch(rng, b, hw)
+        labels = np.array([y[i, int(bx[2]) + 1, int(bx[1]) + 1]
+                           for i, bx in enumerate(boxes)], np.float32)
+        out = tr3.step({"data": x, "rois": boxes,
+                        "roi_softmax_label": labels})
+        if (step + 1) % 25 == 0:
+            acc3 = float((np.asarray(out[0]).argmax(1) == labels).mean())
+            print(f"roi step {step+1}: roi-acc {acc3:.3f}")
+    assert acc3 > 0.9, f"ROI head did not converge: {acc3}"
+    print("fcn + roi example ok")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,151 @@
+"""ImageNet-shape end-to-end training recipe (reference
+``example/image-classification/train_imagenet.py``).
+
+Data flow: im2rec-packed .rec shards -> sharded ``ImageRecordIter``
+(JPEG or raw records, worker-thread decode+augment, PrefetchingIter
+double-buffer) -> ``ShardedTrainer`` (bf16 AMP, one compiled step over
+the data-parallel mesh, optional ZeRO) with per-epoch validation,
+checkpointing, and resume.
+
+Pack the dataset first (both splits; ``--encoding .raw`` trades ~7x
+bytes for decode-free reading)::
+
+    python tools/im2rec.py train /data/imagenet/train --make-list --shuffle
+    python tools/im2rec.py train /data/imagenet/train --lst train.lst \
+        --resize 256 --num-thread 64
+    python tools/im2rec.py val /data/imagenet/val --resize 256
+
+Then::
+
+    python examples/train_imagenet.py --data-train train.rec \
+        --data-val val.rec --model-prefix ckpt/resnet50 --num-epochs 90
+
+Resume after interruption with ``--load-epoch N``.  Multi-host: run one
+process per host with MXTPU_COORDINATOR/MXTPU_NUM_PROC/MXTPU_PROC_ID
+set — each process reads its own shard (``num_parts`` = process count)
+and feeds its slice of the global batch.
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def build_iters(args, num_parts, part_index):
+    from mxnet_tpu.image_io import ImageRecordIter
+    from mxnet_tpu.io import PrefetchingIter
+    train = ImageRecordIter(
+        path_imgrec=args.data_train,
+        path_imgidx=os.path.splitext(args.data_train)[0] + ".idx",
+        data_shape=tuple(int(x) for x in args.image_shape.split(",")),
+        batch_size=args.batch_size // num_parts,
+        shuffle=True, rand_crop=True, rand_mirror=True,
+        mean_r=123.68, mean_g=116.78, mean_b=103.94,
+        num_parts=num_parts, part_index=part_index,
+        preprocess_threads=args.data_nthreads)
+    steps = train.steps_per_epoch
+    train = PrefetchingIter([train])
+    train.steps_per_epoch = steps    # resume clock (wrapper is opaque)
+    val = None
+    if args.data_val:
+        val = ImageRecordIter(
+            path_imgrec=args.data_val,
+            path_imgidx=os.path.splitext(args.data_val)[0] + ".idx",
+            data_shape=tuple(int(x) for x in args.image_shape.split(",")),
+            batch_size=args.batch_size // num_parts,
+            shuffle=False, rand_crop=False, rand_mirror=False,
+            mean_r=123.68, mean_g=116.78, mean_b=103.94,
+            num_parts=num_parts, part_index=part_index,
+            preprocess_threads=args.data_nthreads)
+    return train, val
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--data-train", required=True)
+    ap.add_argument("--data-val", default=None)
+    ap.add_argument("--network", default="resnet")
+    ap.add_argument("--depth", type=int, default=50)
+    ap.add_argument("--num-classes", type=int, default=1000)
+    ap.add_argument("--image-shape", default="3,224,224")
+    ap.add_argument("--batch-size", type=int, default=256,
+                    help="GLOBAL batch size")
+    ap.add_argument("--num-epochs", type=int, default=90)
+    ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--lr-factor", type=float, default=0.1)
+    ap.add_argument("--lr-step-epochs", default="30,60,80")
+    ap.add_argument("--mom", type=float, default=0.9)
+    ap.add_argument("--wd", type=float, default=1e-4)
+    ap.add_argument("--model-prefix", default=None)
+    ap.add_argument("--load-epoch", type=int, default=0)
+    ap.add_argument("--data-nthreads", type=int,
+                    default=max(4, (os.cpu_count() or 4) - 2))
+    ap.add_argument("--zero", action="store_true",
+                    help="shard optimizer state over the data axis")
+    ap.add_argument("--no-amp", action="store_true",
+                    help="disable bf16 activation flow")
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import models
+    from mxnet_tpu.lr_scheduler import MultiFactorScheduler
+    from mxnet_tpu.parallel import ShardedTrainer, make_mesh, dist
+
+    # multi-host: rendezvous first, then the global mesh
+    num_parts, part_index = 1, 0
+    if "MXTPU_COORDINATOR" in os.environ:
+        dist.init_distributed()
+        num_parts, part_index = dist.process_count(), dist.process_index()
+    mesh = make_mesh({"data": len(jax.devices())})
+
+    train, val = build_iters(args, num_parts, part_index)
+    steps_per_epoch = train.steps_per_epoch
+
+    net_kwargs = {"depth": args.depth} if args.network == "resnet" else {}
+    sym = models.get_symbol(args.network, num_classes=args.num_classes,
+                            **net_kwargs)
+    step_epochs = [int(e) for e in args.lr_step_epochs.split(",") if e]
+    sched = None
+    if step_epochs and steps_per_epoch:
+        sched = MultiFactorScheduler(
+            step=[e * steps_per_epoch for e in step_epochs],
+            factor=args.lr_factor)
+    trainer = ShardedTrainer(
+        sym, mesh=mesh, optimizer="sgd",
+        optimizer_params={"learning_rate": args.lr, "momentum": args.mom,
+                          "wd": args.wd, "lr_scheduler": sched},
+        shard_optimizer=args.zero,
+        compute_dtype=None if args.no_amp else "bfloat16")
+
+    arg_params = aux_params = None
+    if args.model_prefix and args.load_epoch:
+        _, arg_params, aux_params = mx.model.load_checkpoint(
+            args.model_prefix, args.load_epoch)
+        print(f"resumed from {args.model_prefix}-{args.load_epoch:04d}")
+    image = tuple(int(x) for x in args.image_shape.split(","))
+    trainer.bind(data_shapes={"data": (args.batch_size,) + image},
+                 label_shapes={"softmax_label": (args.batch_size,)},
+                 arg_params=arg_params, aux_params=aux_params)
+
+    def checkpoint(epoch, sym_, arg_p, aux_p):
+        if args.model_prefix:
+            os.makedirs(os.path.dirname(args.model_prefix) or ".",
+                        exist_ok=True)
+            mx.model.save_checkpoint(args.model_prefix, epoch + 1, sym_,
+                                     arg_p, aux_p)
+
+    from mxnet_tpu.callback import Speedometer
+    trainer.fit(train, eval_data=val, eval_metric="acc",
+                num_epoch=args.num_epochs, begin_epoch=args.load_epoch,
+                batch_end_callback=Speedometer(args.batch_size, 50),
+                epoch_end_callback=checkpoint)
+
+
+if __name__ == "__main__":
+    main()

@@ -98,23 +98,31 @@ class ImageAugmenter:
         img = img[y:y + th, x:x + tw]
         if self.rand_mirror and rng.randint(2):
             img = img[:, ::-1]
-        img = img.astype(np.float32)
-        if self.max_random_illumination > 0:
-            img = img + rng.uniform(-self.max_random_illumination,
-                                    self.max_random_illumination)
-        if self.max_random_contrast > 0:
-            img = img * (1.0 + rng.uniform(-self.max_random_contrast,
-                                           self.max_random_contrast))
+        if self.max_random_illumination > 0 or self.max_random_contrast > 0:
+            img = img.astype(np.float32)
+            if self.max_random_illumination > 0:
+                img = img + rng.uniform(-self.max_random_illumination,
+                                        self.max_random_illumination)
+            if self.max_random_contrast > 0:
+                img = img * (1.0 + rng.uniform(-self.max_random_contrast,
+                                               self.max_random_contrast))
+        # else: stay uint8 — the batch buffer assignment converts to f32
+        # in one fused pass (no intermediate float copy per image)
         c = self.data_shape[0]
         if img.shape[2] != c:
             if c == 1:
-                img = img.mean(axis=2, keepdims=True)
+                # f32 (not the default f64) keeps the fused
+                # batch-buffer conversion cheap
+                img = img.astype(np.float32).mean(axis=2,
+                                                  keepdims=True)
             elif c == 3 and img.shape[2] == 1:
                 img = np.repeat(img, 3, axis=2)
             else:
                 raise MXNetError(
                     f"image has {img.shape[2]} channels, want {c}")
-        return np.ascontiguousarray(img.transpose(2, 0, 1))
+        # CHW strided VIEW: the consumer copies it once into the batch
+        # buffer (a contiguous copy here would be a second pass)
+        return img.transpose(2, 0, 1)
 
 
 class ImageRecordIter(DataIter):
@@ -269,19 +277,30 @@ class ImageRecordIter(DataIter):
             offs = offs + self._order[:self.batch_size - len(offs)]
         self._cursor += take
         seeds = self._rng.randint(0, 2**31 - 1, size=len(offs))
-        futs = [self._pool.submit(self._decode_at, off, self.aug,
-                                  np.random.RandomState(s))
-                for off, s in zip(offs, seeds)]
-        imgs, labels = zip(*(f.result() for f in futs))
-        data = np.stack(imgs)
+        data = np.empty((self.batch_size,) + tuple(self.data_shape),
+                        np.float32)
+
+        def work(i, off, s):
+            # decode + augment + one fused uint8->f32 write into the
+            # shared batch buffer, all inside the worker (cv2 and numpy
+            # release the GIL for the heavy parts, so the pool scales
+            # across cores)
+            img, label = self._decode_at(off, self.aug,
+                                         np.random.RandomState(s))
+            data[i] = img
+            return label
+
+        futs = [self._pool.submit(work, i, off, s)
+                for i, (off, s) in enumerate(zip(offs, seeds))]
+        labels = [f.result() for f in futs]
         if self._mean is not None:
-            data = data - self._mean
+            data -= self._mean
         if self.scale != 1.0:
-            data = data * self.scale
+            data *= self.scale
         label = np.stack(labels)[:, :self.label_width]
         if self.label_width == 1:
             label = label[:, 0]
-        self._data = nd_array(data.astype(np.float32))
+        self._data = nd_array(data)  # already f32, no copy
         self._label = nd_array(label)
         return True
 

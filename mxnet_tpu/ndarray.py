@@ -428,7 +428,8 @@ def save(fname: str, data: Union[List[NDArray], Dict[str, NDArray]]) -> None:
         arrays = list(data)
     else:
         raise MXNetError("save expects list or dict of NDArrays")
-    with open(fname, "wb") as f:
+    from .stream import open_uri
+    with open_uri(fname, "wb") as f:
         f.write(_SAVE_MAGIC)
         f.write(struct.pack("<qq", len(arrays), len(names)))
         for i, arr in enumerate(arrays):
@@ -446,7 +447,8 @@ def save(fname: str, data: Union[List[NDArray], Dict[str, NDArray]]) -> None:
 
 
 def load(fname: str) -> Union[List[NDArray], Dict[str, NDArray]]:
-    with open(fname, "rb") as f:
+    from .stream import open_uri
+    with open_uri(fname, "rb") as f:
         magic = f.read(8)
         if magic != _SAVE_MAGIC:
             raise MXNetError(f"{fname}: bad magic, not an NDArray file")

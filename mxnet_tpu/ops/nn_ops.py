@@ -869,13 +869,16 @@ def _pallas_softmax_rows(x, block=None):
         )(x)
 
 
+_DISABLE_PALLAS = []  # non-empty -> plain jnp softmax (export tracing)
+
+
 def _softmax_rows(x):
     """Row softmax: Pallas kernel on accelerator backends, jnp on cpu.
 
     ``platform_dependent`` resolves the branch at lowering time, so one
     traced graph works for both the cpu test mesh and the real chip."""
-    if x.ndim != 2 or x.shape[-1] > 16384 or x.dtype not in (
-            jnp.float32, jnp.bfloat16):
+    if (_DISABLE_PALLAS or x.ndim != 2 or x.shape[-1] > 16384
+            or x.dtype not in (jnp.float32, jnp.bfloat16)):
         return jax.nn.softmax(x, axis=-1)
     block = _softmax_row_block(x.shape[0], x.shape[1], x.dtype.itemsize)
     if block is None:

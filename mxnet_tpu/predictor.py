@@ -15,7 +15,8 @@ import numpy as np
 
 from .base import MXNetError
 
-__all__ = ["Predictor", "create", "load_ndarray_file"]
+__all__ = ["Predictor", "create", "load_ndarray_file",
+           "export_model", "load_exported", "ExportedPredictor"]
 
 
 def load_ndarray_file(blob: bytes) -> Dict[str, "np.ndarray"]:
@@ -151,3 +152,113 @@ def create(prefix: str, epoch: int, input_shapes, ctx=None,
         blob = f.read()
     return Predictor(symbol_json, blob, input_shapes, ctx=ctx,
                      output_names=output_names)
+
+
+# ---------------------------------------------------------------------------
+# Single-artifact deployment (the amalgamation analog, TPU-native form)
+# ---------------------------------------------------------------------------
+#
+# The reference's amalgamation concatenates the predict-only C++ path into
+# one .cc so a model can be served with no framework checkout
+# (amalgamation/, MXNET_PREDICT_ONLY).  The TPU-native equivalent is a
+# serialized StableHLO program: `export_model` traces the bound forward
+# with the trained weights baked in as constants and writes ONE file that
+# any process with plain `jax` installed can serve — no mxnet_tpu, no
+# symbol machinery, no params file (see `load_exported`, and the test
+# that serves it from a subprocess importing only jax).
+
+_EXPORT_MAGIC = b"MXTPUEXP1"
+
+
+def export_model(symbol, arg_params, aux_params, input_shapes,
+                 out_path: str) -> None:
+    """Serialize a forward-only model into a single deployable artifact.
+
+    Parameters
+    ----------
+    symbol, arg_params, aux_params : the trained model (e.g. from
+        ``model.load_checkpoint``).
+    input_shapes : dict name -> shape of every data input.
+    out_path : file or ``scheme://`` URI to write.
+    """
+    import json
+    import struct as _struct
+
+    import jax
+    import jax.numpy as jnp
+
+    from .graph_eval import eval_symbol
+    from .stream import open_uri
+
+    params = {k: jnp.asarray(v.asnumpy() if hasattr(v, "asnumpy") else v)
+              for k, v in arg_params.items()}
+    aux = {k: jnp.asarray(v.asnumpy() if hasattr(v, "asnumpy") else v)
+           for k, v in (aux_params or {}).items()}
+    input_names = sorted(input_shapes)
+    topo = symbol._topo()
+    # label-ish inputs that only feed loss heads still need placeholders;
+    # missing ones are zero-filled at trace time
+    arg_names = symbol.list_arguments()
+    missing = [n for n in arg_names
+               if n not in params and n not in input_shapes]
+    if missing:
+        shapes_all, _, _ = symbol.infer_shape(**input_shapes)
+        shape_of = dict(zip(arg_names, shapes_all))
+        for n in missing:
+            params[n] = jnp.zeros(shape_of[n], jnp.float32)
+
+    def forward(*inputs):
+        args = dict(params)
+        args.update(dict(zip(input_names, inputs)))
+        heads, _ = eval_symbol(symbol, args, aux, None, False, topo=topo)
+        return heads
+
+    from jax import export as jexport
+    specs = [jax.ShapeDtypeStruct(tuple(input_shapes[n]), jnp.float32)
+             for n in input_names]
+    # lower for every mainstream platform so the artifact serves
+    # anywhere; Pallas kernels don't cross-lower, so trace with the
+    # plain-XLA softmax path
+    from .ops import nn_ops as _nn_ops
+    _nn_ops._DISABLE_PALLAS.append(True)
+    try:
+        exp = jexport.export(jax.jit(forward),
+                             platforms=("cpu", "tpu"))(*specs)
+    finally:
+        _nn_ops._DISABLE_PALLAS.pop()
+    blob = exp.serialize()
+    header = json.dumps({
+        "inputs": [[n, list(input_shapes[n])] for n in input_names],
+        "num_outputs": len(symbol.list_outputs()),
+    }).encode()
+    with open_uri(out_path, "wb") as f:
+        f.write(_EXPORT_MAGIC)
+        f.write(_struct.pack("<i", len(header)))
+        f.write(header)
+        f.write(blob)
+
+
+class ExportedPredictor:
+    """Serve a `export_model` artifact (needs only jax at runtime)."""
+
+    def __init__(self, path: str):
+        import json
+        import struct as _struct
+        from jax import export as jexport
+        from .stream import open_uri
+        with open_uri(path, "rb") as f:
+            if f.read(len(_EXPORT_MAGIC)) != _EXPORT_MAGIC:
+                raise MXNetError(f"{path}: not an exported model")
+            (hlen,) = _struct.unpack("<i", f.read(4))
+            meta = json.loads(f.read(hlen).decode())
+            self._exported = jexport.deserialize(f.read())
+        self.input_names = [n for n, _ in meta["inputs"]]
+        self.input_shapes = {n: tuple(s) for n, s in meta["inputs"]}
+
+    def predict(self, **inputs) -> List[np.ndarray]:
+        args = [np.asarray(inputs[n], np.float32) for n in self.input_names]
+        return [np.asarray(o) for o in self._exported.call(*args)]
+
+
+def load_exported(path: str) -> ExportedPredictor:
+    return ExportedPredictor(path)

@@ -386,7 +386,8 @@ class Symbol:
         }, indent=2)
 
     def save(self, fname: str) -> None:
-        with open(fname, "w") as f:
+        from .stream import open_uri
+        with open_uri(fname, "w") as f:
             f.write(self.tojson())
 
     # ------------------------------------------------------------------
@@ -599,7 +600,8 @@ def load_json(json_str: str) -> Symbol:
 
 
 def load(fname: str) -> Symbol:
-    with open(fname) as f:
+    from .stream import open_uri
+    with open_uri(fname, "r") as f:
         return load_json(f.read())
 
 

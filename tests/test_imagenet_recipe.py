@@ -1,0 +1,122 @@
+"""End-to-end ImageNet recipe gate: real image files -> im2rec pack
+(list generation + multiprocess encode) -> sharded ImageRecordIter ->
+ResNet ShardedTrainer with checkpoint + resume (VERDICT round-2 item 4).
+
+Small-scale but REAL: actual PNGs on disk, the actual packing tool, the
+actual training script's data flow, and a convergence assertion.
+"""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# class k = distinctive CHANNEL mix (crop/mirror-invariant — augmented
+# training flips and crops, so position-coded classes would be ambiguous)
+_CLASS_COLORS = np.array([[200, 40, 40], [40, 200, 40],
+                          [40, 40, 200], [160, 160, 40]], np.float32)
+
+
+@pytest.fixture(scope="module")
+def image_tree(tmp_path_factory):
+    """4-class tree of 48x48 PNGs: class k = its color cast + noise."""
+    import cv2
+    root = tmp_path_factory.mktemp("imgs")
+    rng = np.random.RandomState(0)
+    for k in range(4):
+        d = root / f"class{k}"
+        d.mkdir()
+        for i in range(40):
+            img = (rng.rand(48, 48, 3) * 80
+                   + _CLASS_COLORS[k] * 0.6).astype(np.uint8)
+            cv2.imwrite(str(d / f"img{i:03d}.png"), img)
+    return root
+
+
+def test_im2rec_list_and_pack(image_tree, tmp_path):
+    """tools/im2rec.py: list generation with split, then packing."""
+    env = dict(os.environ, MXNET_TPU_TESTS="0", JAX_PLATFORMS="cpu")
+    prefix = str(tmp_path / "data")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "im2rec.py"),
+         prefix, str(image_tree), "--make-list", "--shuffle",
+         "--train-ratio", "0.8"],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert r.returncode == 0, r.stderr
+    assert os.path.isfile(prefix + "_train.lst")
+    assert os.path.isfile(prefix + "_val.lst")
+    n_train = sum(1 for _ in open(prefix + "_train.lst"))
+    n_val = sum(1 for _ in open(prefix + "_val.lst"))
+    assert (n_train, n_val) == (128, 32)
+
+    for split in ("train", "val"):
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "im2rec.py"),
+             f"{prefix}_{split}", str(image_tree),
+             "--lst", f"{prefix}_{split}.lst", "--resize", "40",
+             "--num-thread", "2"],
+            capture_output=True, text=True, env=env, timeout=300)
+        assert r.returncode == 0, r.stderr
+        assert os.path.getsize(f"{prefix}_{split}.rec") > 0
+
+    # label/shape survive the round trip through the reader
+    from mxnet_tpu.image_io import ImageRecordIter
+    it = ImageRecordIter(path_imgrec=f"{prefix}_train.rec",
+                         path_imgidx=f"{prefix}_train.idx",
+                         data_shape=(3, 32, 32), batch_size=16,
+                         shuffle=True, rand_crop=True, rand_mirror=True)
+    it.reset()
+    b = next(iter(it))
+    assert b.data[0].shape == (16, 3, 32, 32)
+    labels = b.label[0].asnumpy()
+    assert set(np.unique(labels)).issubset({0.0, 1.0, 2.0, 3.0})
+
+
+def test_recipe_converges_with_checkpoint_resume(image_tree, tmp_path):
+    """train_imagenet.py end to end on the packed data: accuracy climbs,
+    checkpoints are written, resume continues from them."""
+    env = dict(os.environ, MXNET_TPU_TESTS="0", JAX_PLATFORMS="cpu")
+    prefix = str(tmp_path / "data")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "im2rec.py"),
+         prefix, str(image_tree), "--shuffle", "--encoding", ".raw",
+         "--resize", "36"],
+        capture_output=True, text=True, env=env, timeout=300)
+    assert r.returncode == 0, r.stderr
+
+    ckpt = str(tmp_path / "ckpt" / "net")
+    # lenet keeps the 1-core CPU CI box inside the timeout; the data
+    # flow (pack -> sharded reader -> trainer -> ckpt/resume) is the
+    # same one the ResNet-50 config uses on real hardware
+    cmd = [sys.executable,
+           os.path.join(REPO, "examples", "train_imagenet.py"),
+           "--data-train", prefix + ".rec",
+           "--network", "lenet", "--num-classes", "4",
+           "--image-shape", "3,32,32", "--batch-size", "32",
+           "--lr", "0.1", "--lr-step-epochs", "",
+           "--model-prefix", ckpt, "--data-nthreads", "2", "--no-amp"]
+    r = subprocess.run(cmd + ["--num-epochs", "12"], capture_output=True,
+                       text=True, env=env, timeout=560)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert os.path.isfile(ckpt + "-0012.params"), os.listdir(
+        os.path.dirname(ckpt))
+
+    # resume from epoch 12 for three more (with validation); accuracy
+    # must be high (4 separable classes)
+    r = subprocess.run(cmd + ["--num-epochs", "15", "--load-epoch", "12",
+                              "--data-val", prefix + ".rec"],
+                       capture_output=True, text=True, env=env, timeout=560)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "resumed from" in r.stdout
+    import re
+    accs = re.findall(r"Validation-accuracy=\(?'accuracy', ([0-9.]+)",
+                      r.stderr + r.stdout)
+    if not accs:
+        accs = re.findall(r"Validation-accuracy=([0-9.]+)",
+                          r.stderr + r.stdout)
+    assert accs, "no validation accuracy logged:\n" + r.stderr[-2000:]
+    assert float(accs[-1]) > 0.9, (accs, r.stderr[-1500:])

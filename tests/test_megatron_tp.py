@@ -1,0 +1,79 @@
+"""Megatron-style tensor parallelism for transformer-lm: the preset's
+column/row-parallel placement must reproduce the single-device training
+trajectory (VERDICT round-2 item 8)."""
+import numpy as np
+import pytest
+
+import jax
+
+from mxnet_tpu import models
+from mxnet_tpu.parallel import (ShardedTrainer, make_mesh, megatron_rules,
+                                PartitionSpec as P)
+
+
+def _lm(b, l):
+    return models.get_symbol("transformer-lm", vocab_size=32, num_layers=2,
+                             d_model=16, heads=2, batch_size=b, seq_len=l)
+
+
+def _init_params(sym, shapes, seed=11):
+    arg_shapes, _, _ = sym.infer_shape(**shapes)
+    rng = np.random.RandomState(seed)
+    return {n: rng.uniform(-0.1, 0.1, s).astype(np.float32)
+            for n, s in zip(sym.list_arguments(), arg_shapes)
+            if n not in shapes}
+
+
+def test_megatron_rules_cover_transformer_params():
+    rules = megatron_rules()
+    spec = rules.spec_for
+    assert spec("layer0_q_weight") == P("model", None)
+    assert spec("layer1_ffn1_bias") == P("model")
+    assert spec("layer0_proj_weight") == P(None, "model")
+    assert spec("layer1_ffn2_weight") == P(None, "model")
+    assert spec("embed_weight") == P("model", None)
+    assert spec("lm_head_weight") == P("model", None)
+    # layernorms and row-parallel biases stay replicated
+    assert spec("layer0_ln1_gamma") == P()
+    assert spec("layer0_proj_bias") == P()
+
+
+def test_megatron_tp_matches_single_device():
+    b, l = 8, 8
+    sym = _lm(b, l)
+    shapes = {"data": (b, l), "softmax_label": (b, l)}
+    arg_params = _init_params(sym, shapes)
+
+    mesh_tp = make_mesh({"data": 2, "model": 4})
+    tp = ShardedTrainer(sym, mesh=mesh_tp, rules=megatron_rules(),
+                        optimizer="sgd",
+                        optimizer_params={"learning_rate": 0.2,
+                                          "momentum": 0.9})
+    tp.bind(data_shapes={"data": shapes["data"]},
+            label_shapes={"softmax_label": shapes["softmax_label"]},
+            arg_params=arg_params)
+    # placement really sharded over the model axis
+    qkv = tp._params["layer0_q_weight"]
+    assert qkv.sharding.shard_shape(qkv.shape)[0] == qkv.shape[0] // 4
+
+    ref = ShardedTrainer(sym, mesh=make_mesh({"data": 1},
+                                             [jax.devices()[0]]),
+                         optimizer="sgd",
+                         optimizer_params={"learning_rate": 0.2,
+                                           "momentum": 0.9})
+    ref.bind(data_shapes={"data": shapes["data"]},
+             label_shapes={"softmax_label": shapes["softmax_label"]},
+             arg_params=arg_params)
+
+    rng = np.random.RandomState(0)
+    for _ in range(3):
+        toks = rng.randint(0, 32, (b, l)).astype(np.float32)
+        batch = {"data": toks, "softmax_label": np.roll(toks, -1, 1)}
+        out_tp = np.asarray(tp.step(batch)[0])
+        out_ref = np.asarray(ref.step(batch)[0])
+        np.testing.assert_allclose(out_tp, out_ref, rtol=2e-4, atol=2e-5)
+    for n in ref._params:
+        np.testing.assert_allclose(
+            np.asarray(tp._params[n]), np.asarray(ref._params[n]),
+            rtol=5e-4, atol=5e-5,
+            err_msg=f"param {n} diverged under megatron TP")

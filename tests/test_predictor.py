@@ -1,4 +1,6 @@
 """Deployment predictor tests (reference c_predict_api.h parity)."""
+import os
+
 import numpy as np
 
 import mxnet_tpu as mx
@@ -54,3 +56,61 @@ def test_predictor_partial_out(tmp_path):
     (out,) = pred.predict(data=X[:5])
     assert out.shape == (5, 16)
     assert (out >= 0).all()  # relu output
+
+
+def test_export_model_single_artifact(tmp_path):
+    """Amalgamation analog: one StableHLO artifact, served by a process
+    that imports ONLY jax (no mxnet_tpu)."""
+    import subprocess
+    import sys
+
+    import mxnet_tpu as mx
+    import numpy as np
+
+    net = mx.symbol.FullyConnected(data=mx.symbol.Variable("data"),
+                                   num_hidden=5, name="fc")
+    net = mx.symbol.SoftmaxOutput(data=net, name="softmax")
+    rng = np.random.RandomState(0)
+    arg = {"fc_weight": mx.nd.array(rng.randn(5, 7).astype(np.float32)),
+           "fc_bias": mx.nd.array(rng.randn(5).astype(np.float32))}
+    out = str(tmp_path / "model.mxtpu")
+    from mxnet_tpu.predictor import export_model, load_exported
+    export_model(net, arg, {}, {"data": (4, 7)}, out)
+
+    x = rng.rand(4, 7).astype(np.float32)
+    # in-process serving
+    pred = load_exported(out)
+    y = pred.predict(data=x)[0]
+    # reference result through the regular executor
+    ref = mx.predictor.Predictor(net.tojson(),
+                                 {f"arg:{k}": v for k, v in arg.items()},
+                                 {"data": (4, 7)}).predict(data=x)[0]
+    np.testing.assert_allclose(y, ref, rtol=1e-5, atol=1e-6)
+
+    # framework-free serving: subprocess imports jax ONLY
+    code = f"""
+import sys
+sys.modules['mxnet_tpu'] = None  # poison: any import attempt crashes
+import jax
+jax.config.update('jax_platforms', 'cpu')  # axon plugin ignores the env var
+import json, struct
+import numpy as np
+import jax
+from jax import export as jexport
+with open({out!r}, 'rb') as f:
+    assert f.read(9) == b'MXTPUEXP1'
+    (hlen,) = struct.unpack('<i', f.read(4))
+    meta = json.loads(f.read(hlen).decode())
+    exp = jexport.deserialize(f.read())
+x = np.load({str(tmp_path / 'x.npy')!r})
+(y,) = exp.call(x)
+np.save({str(tmp_path / 'y.npy')!r}, np.asarray(y))
+print('served ok')
+"""
+    np.save(str(tmp_path / "x.npy"), x)
+    env = dict(os.environ, JAX_PLATFORMS="cpu", MXNET_TPU_TESTS="0")
+    r = subprocess.run([sys.executable, "-c", code], env=env,
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    y_sub = np.load(str(tmp_path / "y.npy"))
+    np.testing.assert_allclose(y_sub, ref, rtol=1e-5, atol=1e-6)
