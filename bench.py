@@ -198,11 +198,11 @@ def bench_lm(args):
     from mxnet_tpu import models
 
     b, l = args.batch_size, args.seq_len
-    vocab = 32000
+    vocab = args.vocab
     sym = models.get_symbol(
         "transformer-lm", vocab_size=vocab, num_layers=args.num_layers,
         d_model=args.d_model, heads=max(1, args.d_model // 64),
-        batch_size=b, seq_len=l)
+        batch_size=b, seq_len=l, remat=args.remat)
     trainer = _make_trainer(sym, args.precision, args.compute_dtype,
                             optimizer="adam",
                             optimizer_params={"learning_rate": 1e-3})
@@ -248,6 +248,9 @@ def main():
                     help="AMP activation dtype ('none' keeps f32 "
                     "activations)")
     ap.add_argument("--seq-len", type=int, default=1024)
+    ap.add_argument("--remat", action="store_true",
+                    help="block-level recompute (fits 32k-token training)")
+    ap.add_argument("--vocab", type=int, default=32000)
     ap.add_argument("--d-model", type=int, default=512)
     ap.add_argument("--num-layers", type=int, default=6)
     args = ap.parse_args()

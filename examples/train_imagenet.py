@@ -134,7 +134,9 @@ def main():
                  arg_params=arg_params, aux_params=aux_params)
 
     def checkpoint(epoch, sym_, arg_p, aux_p):
-        if args.model_prefix:
+        # one writer per job: concurrent multi-host saves to a shared
+        # path would interleave and corrupt the checkpoint
+        if args.model_prefix and part_index == 0:
             os.makedirs(os.path.dirname(args.model_prefix) or ".",
                         exist_ok=True)
             mx.model.save_checkpoint(args.model_prefix, epoch + 1, sym_,

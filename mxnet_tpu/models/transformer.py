@@ -11,7 +11,10 @@ Shapes are baked per config (batch/seq len) because the 2016-era
 through explicit ``Reshape``s — the same static-unroll style as the
 reference's ``example/rnn/lstm.py``.
 """
+import contextlib
+
 from .. import symbol as sym
+from ..attribute import AttrScope
 
 
 def _linear(x, b, l, d_in, d_out, name):
@@ -50,15 +53,21 @@ def transformer_block(x, b, l, d, heads, name, causal=True):
 
 
 def transformer_lm(vocab_size=256, num_layers=2, d_model=64, heads=4,
-                   batch_size=8, seq_len=64, causal=True):
+                   batch_size=8, seq_len=64, causal=True, remat=False):
     """Build the LM symbol; inputs ``data``/``softmax_label`` are
-    ``[batch, seq]`` token ids."""
+    ``[batch, seq]`` token ids.  ``remat=True`` wraps each block in a
+    ``remat_scope`` so backward recomputes the block from its boundary
+    activations (jax.checkpoint over the subgraph) — the memory lever
+    that fits 32k-token training on one chip."""
     b, l, d = batch_size, seq_len, d_model
     net = sym.Embedding(data=sym.Variable("data"), input_dim=vocab_size,
                         output_dim=d, name="embed")
     for i in range(num_layers):
-        net = transformer_block(net, b, l, d, heads, f"layer{i}",
-                                causal=causal)
+        scope = (AttrScope(remat_scope=f"layer{i}") if remat
+                 else contextlib.nullcontext())
+        with scope:
+            net = transformer_block(net, b, l, d, heads, f"layer{i}",
+                                    causal=causal)
     net = _layernorm(net, "final_ln")
     net = sym.Reshape(data=net, shape=(b * l, d))
     net = sym.FullyConnected(data=net, num_hidden=vocab_size, name="lm_head")

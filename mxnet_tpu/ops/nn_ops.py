@@ -892,12 +892,14 @@ def _softmax_rows(x):
 def _softmax_output_core(data, label, grad_scale, ignore_label, multi_output,
                          use_ignore, normalization):
     # loss heads compute in >=f32 regardless of the activation dtype (AMP
-    # policy: softmax/log in bf16 destroys small probabilities); the
-    # backward grad leaves in f32 and is cast by the consuming op's VJP
-    data = _amp_f32(data)
+    # policy: softmax/log in bf16 destroys small probabilities).  The
+    # cast happens INSIDE fwd/bwd so the residual keeps the ORIGINAL
+    # dtype — for a [B*L, vocab] LM head under bf16 AMP that halves the
+    # saved-logits HBM (gigabytes at long context).
 
     @jax.custom_vjp
     def _fn(data, label):
+        data = _amp_f32(data)
         if multi_output and data.ndim > 2:
             return jax.nn.softmax(data, axis=1)
         return _softmax_rows(data)
@@ -910,6 +912,8 @@ def _softmax_output_core(data, label, grad_scale, ignore_label, multi_output,
         # * grad_scale, optionally normalized by batch/valid count
         # (softmax_output-inl.h Backward, SoftmaxOutputParam normalization)
         data, label = res
+        in_dtype = data.dtype
+        data = _amp_f32(data)
         if multi_output and data.ndim > 2:
             prob = jax.nn.softmax(data, axis=1)
             oh = jax.nn.one_hot(label.astype(jnp.int32), data.shape[1],
@@ -932,7 +936,7 @@ def _softmax_output_core(data, label, grad_scale, ignore_label, multi_output,
             denom = jnp.maximum(jnp.sum(mask) if use_ignore
                                 else jnp.asarray(float(label.size)), 1.0)
             grad = grad / denom
-        return grad, jnp.zeros_like(label)
+        return grad.astype(in_dtype), jnp.zeros_like(label)
 
     _fn.defvjp(_fwd, _bwd)
     return _fn(data, label)

@@ -381,7 +381,11 @@ def pack_img(header, img, quality=95, img_fmt=".jpg"):
         if img.ndim == 2:
             img = img[:, :, None]
         h, w, c = img.shape
-        blob = _RAW_MAGIC + struct.pack("HHH", h, w, c) + img.tobytes()
+        if max(h, w, c) > 0xFFFF:
+            raise MXNetError(
+                f"raw records cap dimensions at 65535, got {img.shape}")
+        # explicit little-endian: .rec files are cross-machine artifacts
+        blob = _RAW_MAGIC + struct.pack("<HHH", h, w, c) + img.tobytes()
         return pack(header, blob)
     import cv2
     if img_fmt in (".jpg", ".jpeg"):
@@ -401,7 +405,7 @@ def unpack_img(s, iscolor=-1):
     (see :func:`pack_img`) skip the codec entirely."""
     header, img_bytes = unpack(s)
     if img_bytes[:4] == _RAW_MAGIC:
-        h, w, c = struct.unpack("HHH", img_bytes[4:10])
+        h, w, c = struct.unpack("<HHH", img_bytes[4:10])
         img = np.frombuffer(img_bytes, dtype=np.uint8,
                             offset=10).reshape(h, w, c)
         return header, img

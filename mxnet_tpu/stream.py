@@ -98,19 +98,19 @@ register_scheme("memory", _open_memory)
 # -- remote object stores (optional deps) ------------------------------------
 
 def _open_remote(uri: str, mode: str):
+    scheme, rest = split_scheme(uri)
     try:
         import fsspec
+        return fsspec.open(uri, mode).open()
     except ImportError:
+        pass
+    if scheme == "s3":  # boto3 speaks ONLY AWS S3 — never gs/hdfs
         try:
-            import boto3  # noqa: F401
+            import boto3
         except ImportError:
-            scheme, _ = split_scheme(uri)
             raise MXNetError(
-                f"{scheme}:// streams need the 'fsspec' (or 'boto3') "
-                "package; install one or register_scheme a custom opener")
-        # boto3-only path: wrap get/put object
-        import boto3
-        scheme, rest = split_scheme(uri)
+                "s3:// streams need the 'fsspec' or 'boto3' package; "
+                "install one or register_scheme a custom opener")
         bucket, _, key = rest.partition("/")
         s3 = boto3.client("s3")
         if "w" in mode:
@@ -124,7 +124,9 @@ def _open_remote(uri: str, mode: str):
         body = s3.get_object(Bucket=bucket, Key=key)["Body"].read()
         buf = io.BytesIO(body)
         return io.TextIOWrapper(buf) if "b" not in mode else buf
-    return fsspec.open(uri, mode).open()
+    raise MXNetError(
+        f"{scheme}:// streams need the 'fsspec' package; install it or "
+        "register_scheme a custom opener")
 
 
 register_scheme("s3", _open_remote)

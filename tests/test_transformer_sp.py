@@ -80,3 +80,46 @@ def test_transformer_lm_learns():
     pred = np.asarray(out[0]).argmax(-1).reshape(b, l)
     acc = (pred == X).mean()
     assert acc > 0.8, acc
+
+
+def test_remat_scope_matches_plain():
+    """remat_scope (block-level jax.checkpoint in eval_symbol) must not
+    change the training trajectory — only the memory profile."""
+    import numpy as np
+    import jax
+    from mxnet_tpu import models
+    from mxnet_tpu.parallel import ShardedTrainer, make_mesh
+
+    b, l = 4, 16
+    shapes = {"data": (b, l), "softmax_label": (b, l)}
+
+    def build(remat):
+        sym = models.get_symbol("transformer-lm", vocab_size=32,
+                                num_layers=2, d_model=16, heads=2,
+                                batch_size=b, seq_len=l, remat=remat)
+        arg_shapes, _, _ = sym.infer_shape(**shapes)
+        rng = np.random.RandomState(7)
+        arg_params = {n: rng.uniform(-0.1, 0.1, s).astype(np.float32)
+                      for n, s in zip(sym.list_arguments(), arg_shapes)
+                      if n not in shapes}
+        tr = ShardedTrainer(sym, mesh=make_mesh({"data": 1},
+                                                [jax.devices()[0]]),
+                            optimizer="sgd",
+                            optimizer_params={"learning_rate": 0.2})
+        tr.bind(data_shapes={"data": shapes["data"]},
+                label_shapes={"softmax_label": shapes["softmax_label"]},
+                arg_params=arg_params)
+        return tr
+
+    plain, remat = build(False), build(True)
+    rng = np.random.RandomState(0)
+    for _ in range(3):
+        toks = rng.randint(0, 32, (b, l)).astype(np.float32)
+        batch = {"data": toks, "softmax_label": np.roll(toks, -1, 1)}
+        o1 = np.asarray(plain.step(batch)[0])
+        o2 = np.asarray(remat.step(batch)[0])
+        np.testing.assert_allclose(o1, o2, rtol=2e-5, atol=2e-6)
+    for n in plain._params:
+        np.testing.assert_allclose(
+            np.asarray(plain._params[n]), np.asarray(remat._params[n]),
+            rtol=5e-5, atol=5e-6, err_msg=f"{n} diverged under remat")
