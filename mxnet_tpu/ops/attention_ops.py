@@ -31,8 +31,14 @@ def _attention_fwd(ctx, params, q, k, v):
     block = params["block_size"]
     if block == 0:
         lk = k.shape[2]
-        if lk > 2048:
-            block = 512 if lk % 512 == 0 else None
+        # at 2048 the dense [L, L] f32 scores are already 16 MB per
+        # head-batch row saved for backward — 6L/batch-8 configs OOM a
+        # 16 GB chip, so the flash path takes over AT the threshold
+        if lk >= 2048:
+            # largest power-of-two block that divides L (the comment
+            # above is exactly why we must NOT fall back to dense here)
+            block = next((b for b in (512, 256, 128, 64)
+                          if lk % b == 0), None)
         else:
             block = None
     return local_attention(q, k, v, causal=causal, block_size=block or None)
@@ -102,8 +108,8 @@ register_op(OpDef(
         "causal": OpParam("causal", "bool", default=False),
         "seq_axis": OpParam("seq_axis", "str", default="seq"),
         "block_size": OpParam("block_size", "int", default=0,
-                              doc="0 = auto (dense below 2048, blockwise "
-                                  "flash-style above)"),
+                              doc="0 = auto (dense below 2048, flash-style "
+                                  "blockwise at/above)"),
     },
     infer_shape=_attention_shape,
     doc="Exact scaled-dot-product attention over [B, H, L, D]; "
