@@ -35,10 +35,19 @@ def _attention_fwd(ctx, params, q, k, v):
         # head-batch row saved for backward — 6L/batch-8 configs OOM a
         # 16 GB chip, so the flash path takes over AT the threshold
         if lk >= 2048:
-            # largest power-of-two block that divides L (the comment
-            # above is exactly why we must NOT fall back to dense here)
+            # largest power-of-two block that divides L; lengths with no
+            # divisor >= 64 (blockwise requires divisibility) fall back
+            # to dense WITH a warning — pad the sequence or pass
+            # block_size explicitly to avoid the [L, L] score memory
             block = next((b for b in (512, 256, 128, 64)
                           if lk % b == 0), None)
+            if block is None:
+                import logging
+                logging.getLogger(__name__).warning(
+                    "attention seq len %d >= 2048 has no power-of-two "
+                    "block divisor; using DENSE attention ([L, L] scores "
+                    "materialize) — pad the sequence to a multiple of 64",
+                    lk)
         else:
             block = None
     return local_attention(q, k, v, causal=causal, block_size=block or None)

@@ -51,6 +51,7 @@ BIGARRAY_BOUND = int(os.environ.get("MXNET_KVSTORE_BIGARRAY_BOUND", 1 << 20))
 
 _STOP_SERVER = -1   # kvstore_dist_server.h:22
 _SYNC_MODE = -2     # kvstore_dist_server.h:23
+_ABORT_JOB = -3     # failure detection (no reference analog: jobs hung)
 
 
 # ---------------------------------------------------------------------------
@@ -124,9 +125,57 @@ def run_scheduler(cfg: Optional[Dict[str, Any]] = None) -> None:
     servers: List[Tuple[str, int]] = []
     worker_socks: List[socket.socket] = []
     barrier_waiting: List[socket.socket] = []
-    state = {"stops": 0, "done": False}
+    state = {"stops": 0, "done": False, "failed": None}
+
+    def _fail(reason: str):
+        """Failure detection: a registered worker died before 'stop'.
+        Three propagation paths (the upgrade over the reference, whose
+        distributed jobs just wedge and need tools/kill-mxnet.py,
+        SURVEY §5): barrier waiters (and future arrivals) get a clear
+        error; every SERVER gets an abort command so survivors blocked
+        inside sync-mode push waits error out too; and the scheduler
+        itself lingers for a grace period before exiting so late
+        barrier calls still receive the designed message instead of a
+        connection reset."""
+        with lock:
+            already = state["failed"] is not None
+            if not already:
+                state["failed"] = reason
+            for c in barrier_waiting:
+                try:
+                    _send(c, ("barrier_failed", reason))
+                except OSError:
+                    pass
+            barrier_waiting.clear()
+            server_addrs = list(servers)
+        if already:
+            return
+        def notify_server(h, p):
+            # short socket timeout: an unreachable server host (the dead
+            # worker's machine) must not stall abort propagation on the
+            # ~2 min OS SYN timeout
+            try:
+                c = socket.create_connection((h, p), timeout=3)
+                c.settimeout(3)
+                _send(c, ("cmd", _ABORT_JOB, reason.encode()))
+                _recv(c)
+                c.close()
+            except (MXNetError, OSError):
+                pass
+
+        for (h, p) in server_addrs:  # parallel fan-out
+            threading.Thread(target=notify_server, args=(h, p),
+                             daemon=True).start()
+
+        def _shutdown():
+            with lock:
+                state["done"] = True
+                lock.notify_all()
+        threading.Timer(10.0, _shutdown).start()
 
     def handle(conn: socket.socket):
+        is_worker = False
+        stopped = False
         try:
             while True:
                 msg = _recv(conn)
@@ -143,15 +192,20 @@ def run_scheduler(cfg: Optional[Dict[str, Any]] = None) -> None:
                             lock.wait()
                         worker_socks.append(conn)
                         rank = len(worker_socks) - 1
+                        is_worker = True
                     _send(conn, ("ok", rank, list(servers)))
                 elif kind == "barrier":
                     with lock:
+                        if state["failed"] is not None:
+                            _send(conn, ("barrier_failed", state["failed"]))
+                            continue
                         barrier_waiting.append(conn)
                         if len(barrier_waiting) == cfg["num_workers"]:
                             for c in barrier_waiting:
                                 _send(c, ("barrier_done",))
                             barrier_waiting.clear()
                 elif kind == "stop":
+                    stopped = True
                     with lock:
                         state["stops"] += 1
                         if state["stops"] >= cfg["num_workers"]:
@@ -160,6 +214,10 @@ def run_scheduler(cfg: Optional[Dict[str, Any]] = None) -> None:
                     return
         except (MXNetError, OSError):
             return
+        finally:
+            if is_worker and not stopped:
+                _fail("a worker process died (connection lost before "
+                      "'stop'); aborting the job")
 
     def acceptor():
         while True:
@@ -189,7 +247,20 @@ class _ServerState:
         self.push_count: Dict[Any, int] = {}
         self.round_no: Dict[Any, int] = {}
         self.updater = None
+        self.aborted: Optional[str] = None
         self.lock = threading.Condition()
+
+    def abort(self, reason: str) -> None:
+        """Failure propagation: wake every sync-wait so surviving
+        workers' RPCs error out instead of blocking forever on a
+        contribution that will never arrive."""
+        with self.lock:
+            self.aborted = reason
+            self.lock.notify_all()
+
+    def _check_abort(self):
+        if self.aborted is not None:
+            raise MXNetError(f"job aborted: {self.aborted}")
 
     def set_optimizer_blob(self, blob: bytes) -> None:
         from ..optimizer import get_updater
@@ -236,10 +307,13 @@ class _ServerState:
                 self.lock.notify_all()
             else:
                 while self.round_no[key] == my_round:
+                    self._check_abort()
                     self.lock.wait()
+                self._check_abort()
 
     def pull(self, key) -> np.ndarray:
         with self.lock:
+            self._check_abort()
             if key not in self.store:
                 raise MXNetError(f"dist server: key {key!r} not initialized")
             return self.store[key].asnumpy()
@@ -289,7 +363,11 @@ def run_server(cfg: Optional[Dict[str, Any]] = None) -> None:
                             _send(conn, ("ok",))
                             done.set()
                             return
-                        if head == _SYNC_MODE:
+                        if head == _ABORT_JOB:
+                            state.abort(body.decode("utf-8", "replace")
+                                        if isinstance(body, bytes)
+                                        else str(body))
+                        elif head == _SYNC_MODE:
                             state.sync_mode = True
                         elif head == 0:
                             state.set_optimizer_blob(body)

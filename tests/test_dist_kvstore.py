@@ -71,3 +71,98 @@ def test_priority_sender_error_surfaces_at_flush():
     with pytest.raises(RuntimeError, match="boom"):
         s.flush()
     s.close()
+
+
+def test_scheduler_detects_dead_worker():
+    """A worker dying mid-job must fail the others' barriers promptly
+    instead of wedging the cluster (the upgrade over the reference's
+    hang + tools/kill-mxnet.py story)."""
+    import socket
+    import threading
+    from mxnet_tpu.parallel import dist_kvstore as dk
+
+    ls = socket.socket()
+    ls.bind(("127.0.0.1", 0))
+    port = ls.getsockname()[1]
+    ls.close()
+    cfg = {"role": "scheduler", "root_host": "127.0.0.1",
+           "root_port": port, "num_workers": 2, "num_servers": 0}
+    t = threading.Thread(target=dk.run_scheduler, args=(cfg,), daemon=True)
+    t.start()
+
+    a = dk._connect("127.0.0.1", port)
+    b = dk._connect("127.0.0.1", port)
+    dk._send(a, ("register_worker",))
+    assert dk._recv(a)[0] == "ok"
+    dk._send(b, ("register_worker",))
+    assert dk._recv(b)[0] == "ok"
+
+    # A parks in a barrier; B dies without sending 'stop'
+    dk._send(a, ("barrier",))
+    b.close()
+    a.settimeout(10)
+    reply = dk._recv(a)
+    assert reply[0] == "barrier_failed", reply
+    assert "died" in reply[1]
+    # subsequent barriers fail immediately too
+    dk._send(a, ("barrier",))
+    reply = dk._recv(a)
+    assert reply[0] == "barrier_failed", reply
+    a.close()
+    # grace period is 10s; leave real margin for loaded CI machines
+    t.join(timeout=25)
+    assert not t.is_alive(), "scheduler did not shut down after failure"
+
+
+def test_dead_worker_aborts_server_sync_wait():
+    """A survivor blocked in a sync-mode server push (no barrier in
+    sight) must get an error once the scheduler detects the death —
+    the wedge the reference could only resolve with kill-mxnet.py."""
+    import socket
+    import threading
+    import time as _time
+    from mxnet_tpu.parallel import dist_kvstore as dk
+
+    ls = socket.socket()
+    ls.bind(("127.0.0.1", 0))
+    port = ls.getsockname()[1]
+    ls.close()
+    cfg = {"role": "scheduler", "root_host": "127.0.0.1",
+           "root_port": port, "num_workers": 2, "num_servers": 1}
+    threading.Thread(target=dk.run_scheduler, args=(cfg,),
+                     daemon=True).start()
+    threading.Thread(target=dk.run_server,
+                     args=(dict(cfg, role="server"),), daemon=True).start()
+
+    a = dk._connect("127.0.0.1", port)
+    b = dk._connect("127.0.0.1", port)
+    dk._send(a, ("register_worker",))
+    ra = dk._recv(a)
+    dk._send(b, ("register_worker",))
+    rb = dk._recv(b)
+    (host, sport) = ra[2][0]
+
+    sa = socket.create_connection((host, sport), timeout=10)
+    import numpy as np
+    dk._send(sa, ("cmd", dk._SYNC_MODE, b""))
+    assert dk._recv(sa)[0] == "ok"
+    dk._send(sa, ("init", 0, dk._pack_arr(np.zeros(4, np.float32))))
+    assert dk._recv(sa)[0] == "ok"
+
+    # worker A pushes (sync mode waits for worker B's contribution)...
+    result = {}
+
+    def push_blocking():
+        dk._send(sa, ("push", 0, dk._pack_arr(np.ones(4, np.float32))))
+        result["reply"] = dk._recv(sa)
+
+    t = threading.Thread(target=push_blocking, daemon=True)
+    t.start()
+    _time.sleep(0.5)
+    assert "reply" not in result, "push should be waiting for worker B"
+    # ...then worker B dies
+    b.close()
+    t.join(timeout=15)
+    assert result.get("reply", ("none",))[0] == "err", result
+    assert "aborted" in result["reply"][1]
+    a.close()
