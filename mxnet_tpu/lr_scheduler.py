@@ -2,10 +2,12 @@
 from __future__ import annotations
 
 import logging
+import math
 
 from .base import MXNetError
 
-__all__ = ["LRScheduler", "FactorScheduler", "MultiFactorScheduler"]
+__all__ = ["LRScheduler", "FactorScheduler", "MultiFactorScheduler",
+           "WarmupScheduler", "CosineScheduler"]
 
 
 class LRScheduler:
@@ -77,3 +79,51 @@ class MultiFactorScheduler(LRScheduler):
             else:
                 return self.base_lr
         return self.base_lr
+
+
+class WarmupScheduler(LRScheduler):
+    """Linear warmup wrapping another scheduler (capability upgrade —
+    the 2016 reference predates warmup becoming standard for large-batch
+    and transformer training).
+
+    lr ramps 0 -> base over ``warmup_steps``, then delegates to
+    ``after`` (or holds base_lr)."""
+
+    def __init__(self, warmup_steps: int, after: "LRScheduler" = None,
+                 base_lr: float = 0.01):
+        super().__init__(base_lr)
+        if warmup_steps < 1:
+            raise MXNetError("warmup_steps must be >= 1")
+        self.warmup_steps = warmup_steps
+        self.after = after
+
+    def __call__(self, num_update: int) -> float:
+        # propagate at CALL time: Optimizer.__init__ rewrites base_lr on
+        # this wrapper after construction, and that must reach `after`
+        if self.after is not None:
+            self.after.base_lr = self.base_lr
+        if num_update < self.warmup_steps:
+            return self.base_lr * (num_update + 1) / self.warmup_steps
+        if self.after is not None:
+            return self.after(num_update - self.warmup_steps)
+        return self.base_lr
+
+
+class CosineScheduler(LRScheduler):
+    """Cosine decay base_lr -> final_lr over ``max_update`` steps
+    (capability upgrade; the modern LM default)."""
+
+    def __init__(self, max_update: int, final_lr: float = 0.0,
+                 base_lr: float = 0.01):
+        super().__init__(base_lr)
+        if max_update < 1:
+            raise MXNetError("max_update must be >= 1")
+        self.max_update = max_update
+        self.final_lr = final_lr
+
+    def __call__(self, num_update: int) -> float:
+        if num_update >= self.max_update:
+            return self.final_lr
+        frac = num_update / self.max_update
+        return (self.final_lr + (self.base_lr - self.final_lr)
+                * 0.5 * (1.0 + math.cos(math.pi * frac)))

@@ -25,7 +25,8 @@ from .base import MXNetError, Registry
 from .lr_scheduler import LRScheduler
 from .ndarray import NDArray
 
-__all__ = ["Optimizer", "SGD", "NAG", "SGLD", "ccSGD", "Adam", "AdaGrad",
+__all__ = ["Optimizer", "SGD", "NAG", "SGLD", "ccSGD", "Adam", "AdamW",
+           "AdaGrad",
            "RMSProp", "AdaDelta", "Test", "create", "get_updater", "register"]
 
 OPTIMIZER_REGISTRY: Registry = Registry("optimizer")
@@ -312,6 +313,26 @@ class Adam(Optimizer):
         coef2 = 1.0 - b2 ** t
         lr_t = lr * jnp.sqrt(coef2) / coef1
         return w - lr_t * m / (jnp.sqrt(v) + hyper["epsilon"]), (m, v)
+
+
+@register
+class AdamW(Adam):
+    """Adam with DECOUPLED weight decay (capability upgrade — the modern
+    transformer default; the 2016 reference's Adam folds wd into the
+    gradient, which interacts badly with the adaptive scaling).
+    Hyperparams/state come from :class:`Adam`; only the step differs."""
+
+    @staticmethod
+    def _functional_step(hyper, w, g, state, lr, wd, t, rng):
+        mean, variance = state
+        b1, b2 = hyper["beta1"], hyper["beta2"]
+        g = _prep_grad(g, hyper)               # NO wd folded into g
+        m = b1 * mean + (1.0 - b1) * g
+        v = b2 * variance + (1.0 - b2) * g * g
+        t = jnp.asarray(t, dtype=w.dtype)
+        lr_t = lr * jnp.sqrt(1.0 - b2 ** t) / (1.0 - b1 ** t)
+        update = lr_t * m / (jnp.sqrt(v) + hyper["epsilon"])
+        return w - update - lr * wd * w, (m, v)
 
 
 @register
