@@ -98,10 +98,14 @@ class WarmupScheduler(LRScheduler):
         self.after = after
 
     def __call__(self, num_update: int) -> float:
-        # propagate at CALL time: Optimizer.__init__ rewrites base_lr on
-        # this wrapper after construction, and that must reach `after`
-        if self.after is not None:
+        # propagate ONCE, lazily: Optimizer.__init__ rewrites base_lr on
+        # this wrapper after construction and that must reach `after`;
+        # but some schedulers (FactorScheduler) keep their decay STATE in
+        # base_lr, so overwriting on every call would erase their
+        # progress
+        if self.after is not None and not getattr(self, "_synced", False):
             self.after.base_lr = self.base_lr
+            self._synced = True
         if num_update < self.warmup_steps:
             return self.base_lr * (num_update + 1) / self.warmup_steps
         if self.after is not None:

@@ -113,6 +113,7 @@ class ShardedTrainer:
                  matmul_precision: Optional[str] = None,
                  shard_optimizer: bool = False,
                  compute_dtype: Optional[str] = None,
+                 grad_accum: int = 1,
                  logger=None):
         from .. import optimizer as opt_mod
         from ..initializer import Uniform
@@ -155,6 +156,15 @@ class ShardedTrainer:
         # changes the MXU pass mode, not the HBM activation traffic.
         self.compute_dtype = (jnp.dtype(compute_dtype)
                               if compute_dtype else None)
+        # gradient accumulation: the step scans over `grad_accum`
+        # microbatches INSIDE one compiled program, summing grads before
+        # a single optimizer update — activation memory scales with the
+        # microbatch, so a big effective batch fits one chip (composes
+        # with remat_scope for long context).  Per-microbatch BatchNorm
+        # statistics, like every microbatching scheme.
+        self.grad_accum = int(grad_accum)
+        if self.grad_accum < 1:
+            raise MXNetError("grad_accum must be >= 1")
         self._bound = False
 
     def _multiproc(self) -> bool:
@@ -196,10 +206,10 @@ class ShardedTrainer:
         ndata = (self.mesh.shape[self.data_axis]
                  if self.data_axis is not None else 1)
         for name, shape in input_shapes.items():
-            if shape[0] % ndata:
+            if shape[0] % (ndata * self.grad_accum):
                 raise MXNetError(
                     f"global batch {shape[0]} for {name!r} not divisible by "
-                    f"data-axis size {ndata}")
+                    f"data-axis size {ndata} x grad_accum {self.grad_accum}")
         arg_names = sym.list_arguments()
         self._input_names = [n for n in arg_names if n in input_shapes]
         self._param_names = [n for n in arg_names if n not in input_shapes]
@@ -333,17 +343,58 @@ class ShardedTrainer:
             return {n: (v.astype(cdt) if v.dtype == jnp.float32 else v)
                     for n, v in p.items()}
 
-        def train_step(params, aux, opt_state, batch, lr, t):
-            rng = jax.random.fold_in(base_key, t)
+        accum = self.grad_accum
 
+        def _grads_and_heads(params, aux, batch, rng):
             def fwd(p):
                 args = cast_params(p)
                 args.update(batch)
-                heads, auxu = eval_symbol(sym, args, aux, rng, True, topo=topo)
+                heads, auxu = eval_symbol(sym, args, aux, rng, True,
+                                          topo=topo)
                 return heads, auxu
             heads, vjp_fn, auxu = jax.vjp(fwd, params, has_aux=True)
             ones = tuple(jnp.ones(h.shape, h.dtype) for h in heads)
             (grads,) = vjp_fn(ones)
+            return grads, heads, auxu
+
+        def train_step(params, aux, opt_state, batch, lr, t):
+            rng = jax.random.fold_in(base_key, t)
+
+            if accum > 1:
+                # [B, ...] -> [k, B/k, ...]; grads sum across the scan,
+                # one update at the end; activations live per-microbatch
+                def to_micro(v):
+                    r = v.reshape((accum, v.shape[0] // accum) + v.shape[1:])
+                    if self.data_axis is not None:
+                        # keep the PER-MICROBATCH rows sharded over data
+                        spec = P(None, self.data_axis,
+                                 *([None] * (r.ndim - 2)))
+                        r = jax.lax.with_sharding_constraint(
+                            r, NamedSharding(self.mesh, spec))
+                    return r
+                mb = {n: to_micro(v) for n, v in batch.items()}
+                gzero = jax.tree.map(jnp.zeros_like, params)
+
+                # distinct stream from the per-param optimizer keys
+                # (which fold small ints from the same rng)
+                accum_rng = jax.random.fold_in(rng, 0xACC)
+
+                def micro(carry, xs):
+                    aux_c, gsum, i = carry
+                    grads, heads, auxu = _grads_and_heads(
+                        params, aux_c, xs, jax.random.fold_in(accum_rng, i))
+                    aux_n = dict(aux_c)
+                    aux_n.update(auxu)
+                    return (aux_n, jax.tree.map(jnp.add, gsum, grads),
+                            i + 1), heads
+                (auxf, grads, _), heads_k = jax.lax.scan(
+                    micro, (dict(aux), gzero, jnp.int32(0)), mb)
+                heads = tuple(h.reshape((-1,) + h.shape[2:])
+                              for h in heads_k)
+                auxu = auxf
+            else:
+                grads, heads, auxu = _grads_and_heads(params, aux, batch,
+                                                      rng)
             new_params, new_opt = {}, {}
             for i, n in enumerate(param_names):
                 prng = jax.random.fold_in(rng, i) if needs_rng else None

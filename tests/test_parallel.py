@@ -164,3 +164,49 @@ def test_sharded_trainer_aux_states_update():
     moved = any(not np.allclose(before[n], np.asarray(v))
                 for n, v in tr._aux.items())
     assert moved, "moving stats never updated"
+
+
+def test_grad_accum_matches_full_batch():
+    """grad_accum=k (in-program lax.scan over microbatches) must match
+    the full-batch step for BN-free models; effective batch unchanged."""
+    import jax
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu.parallel import ShardedTrainer, make_mesh
+
+    def build(accum):
+        net = mx.symbol.FullyConnected(data=mx.symbol.Variable("data"),
+                                       num_hidden=16, name="fc1")
+        net = mx.symbol.Activation(data=net, act_type="tanh")
+        net = mx.symbol.FullyConnected(data=net, num_hidden=4, name="fc2")
+        net = mx.symbol.SoftmaxOutput(data=net, name="softmax")
+        arg_shapes, _, _ = net.infer_shape(data=(16, 8),
+                                           softmax_label=(16,))
+        rng = np.random.RandomState(5)
+        arg_params = {n: rng.uniform(-0.3, 0.3, s).astype(np.float32)
+                      for n, s in zip(net.list_arguments(), arg_shapes)
+                      if n not in ("data", "softmax_label")}
+        tr = ShardedTrainer(net, mesh=make_mesh({"data": 2},
+                                                jax.devices()[:2]),
+                            optimizer="sgd",
+                            optimizer_params={"learning_rate": 0.5,
+                                              "momentum": 0.9},
+                            grad_accum=accum)
+        tr.bind(data_shapes={"data": (16, 8)},
+                label_shapes={"softmax_label": (16,)},
+                arg_params=arg_params)
+        return tr
+
+    full, accum = build(1), build(4)
+    rng = np.random.RandomState(0)
+    for _ in range(3):
+        batch = {"data": rng.rand(16, 8).astype(np.float32),
+                 "softmax_label": rng.randint(0, 4, (16,))
+                 .astype(np.float32)}
+        h1 = np.asarray(full.step(batch)[0])
+        h2 = np.asarray(accum.step(batch)[0])
+        np.testing.assert_allclose(h1, h2, rtol=2e-5, atol=2e-6)
+    for n in full._params:
+        np.testing.assert_allclose(
+            np.asarray(full._params[n]), np.asarray(accum._params[n]),
+            rtol=5e-5, atol=5e-6, err_msg=f"{n} diverged under grad_accum")
