@@ -71,9 +71,15 @@ def measure(trainer, feeds, steps):
         return time.perf_counter() - t0
 
     run(3)  # warm caches (incl. the fetch program)
-    t1 = run(steps)
-    t2 = run(3 * steps)
-    per_step = (t2 - t1) / (2 * steps)
+    # two independent slope estimates; take the faster one — the chip is
+    # shared through a tunnel and a contended window inflates both ends
+    # of a single slope
+    slopes = []
+    for _ in range(2):
+        t1 = run(steps)
+        t2 = run(3 * steps)
+        slopes.append((t2 - t1) / (2 * steps))
+    per_step = min(slopes)
 
     # dispatch-only cost (no fetch): how fast the host can feed the chip
     t0 = time.perf_counter()
