@@ -84,7 +84,10 @@ def measure(trainer, feeds, steps):
     ok = sorted(s for s in slopes if s > 0)
     if not ok:
         raise RuntimeError(f"all slope estimates corrupted: {slopes}")
-    per_step = ok[len(ok) // 2]
+    # LOWER median: with an even survivor count (one estimate was
+    # negative-corrupted), preferring the faster of the middle pair
+    # avoids reporting a contention-inflated slope
+    per_step = ok[(len(ok) - 1) // 2]
 
     # dispatch-only cost (no fetch): how fast the host can feed the chip
     t0 = time.perf_counter()

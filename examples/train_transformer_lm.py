@@ -35,7 +35,19 @@ def main():
     ap.add_argument("--seq-len", type=int, default=128)
     ap.add_argument("--steps", type=int, default=100)
     ap.add_argument("--lr", type=float, default=0.01)
+    ap.add_argument("--optimizer", default="adam",
+                    help="adam | adamw (decoupled wd) | sgd ...")
+    ap.add_argument("--remat", action="store_true",
+                    help="block-level recompute (32k-token contexts)")
+    ap.add_argument("--grad-accum", type=int, default=1,
+                    help="microbatches per update (big batch, small HBM)")
+    ap.add_argument("--megatron", action="store_true",
+                    help="tensor-parallel qkv/ffn placement (needs a "
+                    "'model' mesh axis)")
     args = ap.parse_args()
+    if args.grad_accum < 1 or args.batch_size % args.grad_accum:
+        ap.error(f"--batch-size {args.batch_size} must be a positive "
+                 f"multiple of --grad-accum {args.grad_accum}")
 
     import jax
     from mxnet_tpu import models
@@ -46,11 +58,17 @@ def main():
     net = models.get_symbol(
         "transformer-lm", vocab_size=args.vocab,
         num_layers=args.num_layers, d_model=args.d_model,
-        heads=args.heads, batch_size=args.batch_size,
-        seq_len=args.seq_len)
-    trainer = ShardedTrainer(net, optimizer="adam",
+        heads=args.heads,
+        # the graph evaluates per microbatch under grad accumulation
+        batch_size=args.batch_size // args.grad_accum,
+        seq_len=args.seq_len, remat=args.remat)
+    from mxnet_tpu.parallel import megatron_rules
+    trainer = ShardedTrainer(net, optimizer=args.optimizer,
                              optimizer_params={"learning_rate": args.lr},
-                             mesh=mesh)
+                             mesh=mesh,
+                             rules=megatron_rules() if args.megatron else None,
+                             grad_accum=args.grad_accum,
+                             compute_dtype="bfloat16")
     trainer.bind(data_shapes={"data": (args.batch_size, args.seq_len)},
                  label_shapes={"softmax_label": (args.batch_size,
                                                  args.seq_len)})

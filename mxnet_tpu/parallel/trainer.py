@@ -215,11 +215,20 @@ class ShardedTrainer:
         self._param_names = [n for n in arg_names if n not in input_shapes]
         self._aux_names = sym.list_auxiliary_states()
 
-        arg_shapes, _, aux_shapes = sym.infer_shape(**input_shapes)
+        # under grad_accum the graph evaluates PER MICROBATCH — symbols
+        # that bake the batch into Reshape ops (transformer-lm) must be
+        # built for the microbatch size, and inference validates that
+        infer_shapes = {n: (s[0] // self.grad_accum,) + tuple(s[1:])
+                        for n, s in input_shapes.items()}
+        arg_shapes, _, aux_shapes = sym.infer_shape(**infer_shapes)
         if any(s is None for s in arg_shapes):
             raise MXNetError("bind: incomplete shape inference")
         shape_of = dict(zip(arg_names, arg_shapes))
-        self._input_shapes = {n: shape_of[n] for n in self._input_names}
+        # _input_shapes keeps the FULL global batch (external consumers
+        # like the bench FLOPs twin rely on that); only inference above
+        # used the microbatch view
+        self._input_shapes = {n: tuple(input_shapes[n])
+                              for n in self._input_names}
 
         # initialize on host, then place onto the mesh with the rule's spec
         host = cpu()
