@@ -130,7 +130,8 @@ def _step_flops(trainer, placed):
             optimizer=type(trainer.optimizer).__name__.lower(),
             optimizer_params={"learning_rate": 0.1},
             compute_dtype=(str(trainer.compute_dtype)
-                           if trainer.compute_dtype is not None else None))
+                           if trainer.compute_dtype is not None else None),
+            grad_accum=trainer.grad_accum)
         shapes = dict(trainer._input_shapes)
         twin.bind(data_shapes=shapes)
         feed = twin.place_batch({n: np.zeros(s, np.float32)

@@ -41,6 +41,9 @@ def main():
                     help="block-level recompute (32k-token contexts)")
     ap.add_argument("--grad-accum", type=int, default=1,
                     help="microbatches per update (big batch, small HBM)")
+    ap.add_argument("--compute-dtype", default="bfloat16",
+                    choices=("bfloat16", "none"),
+                    help="'none' keeps f32 activations")
     ap.add_argument("--megatron", action="store_true",
                     help="tensor-parallel qkv/ffn placement (needs a "
                     "'model' mesh axis)")
@@ -68,7 +71,9 @@ def main():
                              mesh=mesh,
                              rules=megatron_rules() if args.megatron else None,
                              grad_accum=args.grad_accum,
-                             compute_dtype="bfloat16")
+                             compute_dtype=(None
+                                            if args.compute_dtype == "none"
+                                            else args.compute_dtype))
     trainer.bind(data_shapes={"data": (args.batch_size, args.seq_len)},
                  label_shapes={"softmax_label": (args.batch_size,
                                                  args.seq_len)})

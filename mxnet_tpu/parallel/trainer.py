@@ -427,6 +427,21 @@ class ShardedTrainer:
 
         def eval_step(params, aux, batch, t):
             rng = jax.random.fold_in(eval_key, t)
+            if accum > 1:
+                # batch-baked symbols evaluate at the MICROBATCH size;
+                # map the graph over the k microbatches and restitch
+                mb = {n: v.reshape((accum, v.shape[0] // accum)
+                                   + v.shape[1:]) for n, v in batch.items()}
+
+                def one(xs):
+                    args = cast_params(params)
+                    args.update(xs)
+                    heads, _ = eval_symbol(sym, args, aux, rng, False,
+                                           topo=topo)
+                    return heads
+                heads_k = jax.lax.map(one, mb)
+                return tuple(h.reshape((-1,) + h.shape[2:])
+                             for h in heads_k)
             args = cast_params(params)
             args.update(batch)
             heads, _ = eval_symbol(sym, args, aux, rng, False, topo=topo)

@@ -210,3 +210,10 @@ def test_grad_accum_matches_full_batch():
         np.testing.assert_allclose(
             np.asarray(full._params[n]), np.asarray(accum._params[n]),
             rtol=5e-5, atol=5e-6, err_msg=f"{n} diverged under grad_accum")
+    # eval path under accumulation: maps microbatches, restitches rows
+    batch = {"data": rng.rand(16, 8).astype(np.float32),
+             "softmax_label": np.zeros(16, np.float32)}
+    f1 = np.asarray(full.forward(batch)[0])
+    f2 = np.asarray(accum.forward(batch)[0])
+    assert f2.shape == f1.shape
+    np.testing.assert_allclose(f1, f2, rtol=2e-5, atol=2e-6)

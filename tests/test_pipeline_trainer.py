@@ -179,3 +179,30 @@ def test_pipeline_trains_to_high_accuracy():
         out = pp.step({"data": x, "softmax_label": y.astype(np.float32)})
         acc.append(float((np.asarray(out[0]).argmax(1) == y).mean()))
     assert np.mean(acc[-5:]) > 0.9, acc[-5:]
+
+
+def test_pipeline_amp_trains():
+    """compute_dtype='bfloat16' through the stage programs: trains and
+    keeps f32 master params on every stage device."""
+    import jax
+    import jax.numpy as jnp
+    net = _mlp4(widths=(32, 24, 16, 4))
+    pp = PipelineTrainer(net, num_stages=4, num_microbatches=2,
+                         optimizer="sgd",
+                         optimizer_params={"learning_rate": 0.5,
+                                           "momentum": 0.9},
+                         compute_dtype="bfloat16")
+    pp.bind(data_shapes={"data": (16, 16)},
+            label_shapes={"softmax_label": (16,)})
+    rng = np.random.RandomState(4)
+    proto = rng.randn(4, 16).astype(np.float32) * 2
+    acc = []
+    for _ in range(40):
+        y = rng.randint(0, 4, 16)
+        x = proto[y] + rng.randn(16, 16).astype(np.float32) * 0.3
+        out = pp.step({"data": x, "softmax_label": y.astype(np.float32)})
+        acc.append(float((np.asarray(out[0]).argmax(1) == y).mean()))
+    assert np.mean(acc[-5:]) > 0.9, acc[-5:]
+    for ps in pp._params:
+        for n, v in ps.items():
+            assert v.dtype == jnp.float32, (n, v.dtype)

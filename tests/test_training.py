@@ -345,3 +345,17 @@ def test_adamw_trains():
         out = tr.step({"data": x, "softmax_label": y.astype(np.float32)})
         accs.append(float((np.asarray(out[0]).argmax(1) == y).mean()))
     assert np.mean(accs[-5:]) > 0.9, accs[-5:]
+
+
+def test_warmup_preserves_stateful_scheduler_decay():
+    """Wrapping a STATEFUL scheduler (FactorScheduler keeps its decay in
+    base_lr) must not erase its progress on later calls."""
+    from mxnet_tpu.lr_scheduler import FactorScheduler, WarmupScheduler
+    w = WarmupScheduler(5, after=FactorScheduler(step=10, factor=0.5),
+                        base_lr=0.8)
+    assert abs(w(4) - 0.8) < 1e-9            # warmup done at step 5
+    assert abs(w(5) - 0.8) < 1e-9            # factor not yet triggered
+    lr_after_drop = w(5 + 11)                # first factor boundary
+    assert abs(lr_after_drop - 0.4) < 1e-9
+    # calling again must NOT snap back to 0.8
+    assert abs(w(5 + 12) - 0.4) < 1e-9
