@@ -13,8 +13,25 @@ __all__ = ["LRScheduler", "FactorScheduler", "MultiFactorScheduler",
 class LRScheduler:
     """Base: ``__call__(num_update) -> lr`` (reference ``lr_scheduler.py:7``)."""
 
-    def __init__(self, base_lr: float = 0.01):
-        self.base_lr = base_lr
+    def __init__(self, base_lr: float = None):
+        # None = "not explicitly chosen": wrappers (WarmupScheduler) and
+        # Optimizer.__init__ may overwrite an implicit base_lr, but an
+        # explicitly constructed one wins (advisor r3 finding)
+        self._explicit_base_lr = base_lr is not None
+        self.base_lr = 0.01 if base_lr is None else base_lr
+
+    def _set_base_lr_explicit(self, lr: float) -> None:
+        """Stamp an EXPLICIT base_lr (an optimizer's learning_rate=...).
+        Explicit optimizer lr outranks everything; wrappers override to
+        propagate it through to their inner scheduler."""
+        self.base_lr = lr
+        self._explicit_base_lr = True
+
+    def _effective_explicit_base_lr(self):
+        """The explicitly-chosen base_lr this schedule will actually run
+        at, or None if everything is implicit.  Wrappers look through to
+        their inner scheduler so Optimizer.lr backfills correctly."""
+        return self.base_lr if self._explicit_base_lr else None
 
     def __call__(self, num_update: int) -> float:
         raise NotImplementedError
@@ -90,21 +107,43 @@ class WarmupScheduler(LRScheduler):
     ``after`` (or holds base_lr)."""
 
     def __init__(self, warmup_steps: int, after: "LRScheduler" = None,
-                 base_lr: float = 0.01):
+                 base_lr: float = None):
         super().__init__(base_lr)
         if warmup_steps < 1:
             raise MXNetError("warmup_steps must be >= 1")
         self.warmup_steps = warmup_steps
         self.after = after
 
+    def _set_base_lr_explicit(self, lr: float) -> None:
+        # the optimizer's explicit lr is the post-warmup lr too: stamp
+        # the inner scheduler as well, and mark the lazy sync done
+        super()._set_base_lr_explicit(lr)
+        if self.after is not None:
+            self.after._set_base_lr_explicit(lr)
+        self._synced = True
+
+    def _effective_explicit_base_lr(self):
+        if self._explicit_base_lr:
+            return self.base_lr
+        if self.after is not None:
+            return self.after._effective_explicit_base_lr()
+        return None
+
     def __call__(self, num_update: int) -> float:
         # propagate ONCE, lazily: Optimizer.__init__ rewrites base_lr on
         # this wrapper after construction and that must reach `after`;
         # but some schedulers (FactorScheduler) keep their decay STATE in
         # base_lr, so overwriting on every call would erase their
-        # progress
+        # progress — and an inner scheduler constructed with an EXPLICIT
+        # base_lr keeps it (the wrapper only fills in defaults).  When
+        # only the inner is explicit, the wrapper adopts it as the ramp
+        # peak so the warmup->after transition stays continuous.
         if self.after is not None and not getattr(self, "_synced", False):
-            self.after.base_lr = self.base_lr
+            if getattr(self.after, "_explicit_base_lr", False):
+                if not self._explicit_base_lr:
+                    self.base_lr = self.after.base_lr
+            else:
+                self.after.base_lr = self.base_lr
             self._synced = True
         if num_update < self.warmup_steps:
             return self.base_lr * (num_update + 1) / self.warmup_steps
@@ -118,7 +157,7 @@ class CosineScheduler(LRScheduler):
     (capability upgrade; the modern LM default)."""
 
     def __init__(self, max_update: int, final_lr: float = 0.0,
-                 base_lr: float = 0.01):
+                 base_lr: float = None):
         super().__init__(base_lr)
         if max_update < 1:
             raise MXNetError("max_update must be >= 1")

@@ -73,12 +73,13 @@ class Optimizer:
     """Base optimizer (reference ``optimizer.py:25``)."""
 
     _needs_rng = False
+    _default_lr = 0.01
     _JIT_STEPS: Dict[Any, Any] = {}
 
     def __init__(self, rescale_grad: Optional[float] = None,
                  param_idx2name: Optional[Dict[int, str]] = None,
                  wd: float = 0.0, clip_gradient: Optional[float] = None,
-                 learning_rate: float = 0.01,
+                 learning_rate: Optional[float] = None,
                  lr_scheduler: Optional[LRScheduler] = None,
                  sym=None, begin_num_update: int = 0,
                  arg_names=None, **kwargs):
@@ -86,10 +87,28 @@ class Optimizer:
         # default (ShardedTrainer.bind) key off _rescale_set
         self._rescale_set = rescale_grad is not None
         self.rescale_grad = 1.0 if rescale_grad is None else rescale_grad
-        self.lr = learning_rate
+        self.lr = type(self)._default_lr if learning_rate is None \
+            else learning_rate
         self.lr_scheduler = lr_scheduler
         if lr_scheduler is not None:
-            self.lr_scheduler.base_lr = learning_rate
+            # explicit optimizer learning_rate wins (propagated through
+            # wrappers to the inner scheduler); otherwise a scheduler
+            # constructed with an explicit base_lr keeps it and backfills
+            # self.lr (advisor r3: explicit beats implicit)
+            if learning_rate is not None:
+                if hasattr(lr_scheduler, "_set_base_lr_explicit"):
+                    lr_scheduler._set_base_lr_explicit(self.lr)
+                else:
+                    lr_scheduler.base_lr = self.lr
+            else:
+                eff = getattr(lr_scheduler, "_effective_explicit_base_lr",
+                              lambda: None)()
+                if eff is None:
+                    lr_scheduler.base_lr = self.lr
+                else:
+                    # explicit scheduler lr (possibly behind a warmup
+                    # wrapper) backfills the optimizer's lr
+                    self.lr = eff
         self.wd = wd
         self.lr_mult: Dict[str, float] = {}
         self.wd_mult: Dict[str, float] = {}
@@ -284,7 +303,10 @@ class ccSGD(SGD):
 class Adam(Optimizer):
     """Adam (reference ``optimizer.py:506``)."""
 
-    def __init__(self, learning_rate: float = 0.001, beta1: float = 0.9,
+    _default_lr = 0.001
+
+    def __init__(self, learning_rate: Optional[float] = None,
+                 beta1: float = 0.9,
                  beta2: float = 0.999, epsilon: float = 1e-8,
                  decay_factor: float = 1 - 1e-8, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
@@ -363,7 +385,10 @@ class RMSProp(Optimizer):
     """RMSProp with Graves-style momentum terms (reference
     ``optimizer.py:653``)."""
 
-    def __init__(self, learning_rate: float = 0.002, gamma1: float = 0.95,
+    _default_lr = 0.002
+
+    def __init__(self, learning_rate: Optional[float] = None,
+                 gamma1: float = 0.95,
                  gamma2: float = 0.9, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
         self.gamma1 = gamma1

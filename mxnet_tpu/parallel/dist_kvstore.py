@@ -576,13 +576,14 @@ class DistKVStore(KVStore):
                     self._rpc(sid, ("init", wkey, _pack_arr(flat[sl])))
         self.barrier()
 
-    def _merge_local(self, vgroup: List[NDArray]) -> np.ndarray:
+    def _merge_local(self, datas: List[Any]) -> np.ndarray:
         """Reduce this worker's per-device grads via XLA collectives before
-        the host push (device tier rides ICI; host hop carries one copy)."""
-        if len(vgroup) == 1:
-            return vgroup[0].asnumpy()
+        the host push (device tier rides ICI; host hop carries one copy).
+        Takes the raw (immutable) jax arrays snapshotted at push() time."""
+        if len(datas) == 1:
+            return np.asarray(datas[0])
         from .collectives import allreduce_sum
-        reduced = allreduce_sum([v.data for v in vgroup])
+        reduced = allreduce_sum(list(datas))
         return np.asarray(reduced[0])
 
     def push(self, key, value, priority: int = 0) -> None:
@@ -590,13 +591,20 @@ class DistKVStore(KVStore):
         merge and the server RPCs run on per-server sender threads in
         ``priority`` order (``-param_index`` convention), so comm
         overlaps the rest of backward exactly like the reference's
-        engine-wrapped ZPush (``kvstore_dist.h:63-141``)."""
+        engine-wrapped ZPush (``kvstore_dist.h:63-141``).
+
+        Gradient VALUES are snapshotted at call time: the underlying
+        (immutable) jax arrays are captured here, so mutating the NDArray
+        after push() cannot change what gets pushed — matching the
+        reference's engine read-dependency semantics.  Only the
+        device->host fetch is deferred to the sender thread."""
         keys, values = _value_list(key, value)
         for k, vgroup in zip(keys, values):
             shape, dtype = self._meta.get(
                 k, (tuple(vgroup[0].shape), np.dtype(vgroup[0].dtype)))
-            holder = _Lazy(lambda vg=list(vgroup):
-                           self._merge_local(vg).reshape(-1))
+            datas = [v.data for v in vgroup]  # immutable snapshot, no copy
+            holder = _Lazy(lambda ds=datas:
+                           self._merge_local(ds).reshape(-1))
             probe = np.empty(shape, dtype=dtype)
             evs = self._pending.setdefault(k, [])
             for sid, wkey, sl in self._shards_for(k, probe):

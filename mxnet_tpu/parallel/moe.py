@@ -18,6 +18,27 @@ import jax.numpy as jnp
 __all__ = ["switch_ffn", "moe_ffn", "moe_ffn_ep", "load_balance_loss"]
 
 
+def _topk_dispatch(topi, gates, e: int, cap: int, dtype):
+    """Rank-major (GShard) capacity accounting shared by the dense and
+    expert-parallel paths: every token's rank-0 assignment claims a slot
+    before ANY rank-1 assignment does.
+
+    topi : [N, k] expert ids; gates : [N, k] renormalized gate weights.
+    Returns ``(dispatch, combine)``, both ``[N, E, C]``.
+    """
+    n, k = topi.shape
+    onehot_i = jax.nn.one_hot(topi, e, dtype=jnp.int32)       # [N, k, E]
+    flat = onehot_i.transpose(1, 0, 2).reshape(k * n, e)      # [k*N, E]
+    pos = (jnp.cumsum(flat, axis=0) * flat - flat)
+    pos = pos.reshape(k, n, e).transpose(1, 0, 2)             # [N, k, E]
+    keep = ((pos < cap) & (onehot_i > 0)).astype(dtype)
+    slot = jax.nn.one_hot(pos, cap, dtype=dtype)              # [N, k, E, C]
+    disp_k = slot * keep[..., None]
+    dispatch = jnp.sum(disp_k, axis=1)                        # [N, E, C]
+    combine = jnp.sum(disp_k * gates.astype(dtype)[..., None, None], axis=1)
+    return dispatch, combine
+
+
 def moe_ffn(x, gate_w, w1, b1, w2, b2, k: int = 2,
             capacity_factor: float = 1.5):
     """Top-k routed expert feed-forward (k=2 is the GShard default).
@@ -40,17 +61,7 @@ def moe_ffn(x, gate_w, w1, b1, w2, b2, k: int = 2,
     topv, topi = jax.lax.top_k(probs, k)              # [N, k]
     gates = topv / jnp.maximum(jnp.sum(topv, -1, keepdims=True), 1e-9)
 
-    # capacity accounting over the flattened (token, rank) assignment
-    # stream: rank-0 assignments of earlier tokens claim slots first
-    onehot_i = jax.nn.one_hot(topi, e, dtype=jnp.int32)       # [N, k, E]
-    flat = onehot_i.reshape(n * k, e)
-    pos = (jnp.cumsum(flat, axis=0) * flat - flat).reshape(n, k, e)
-    keep = ((pos < cap) & (onehot_i > 0)).astype(x.dtype)     # [N, k, E]
-    slot = jax.nn.one_hot(pos, cap, dtype=x.dtype)            # [N, k, E, C]
-    disp_k = slot * keep[..., None]                           # [N, k, E, C]
-    dispatch = jnp.sum(disp_k, axis=1)                        # [N, E, C]
-    combine = jnp.sum(disp_k * gates.astype(x.dtype)[..., None, None],
-                      axis=1)                                 # [N, E, C]
+    dispatch, combine = _topk_dispatch(topi, gates, e, cap, x.dtype)
 
     expert_in = jnp.einsum("nec,nd->ecd", dispatch, x)        # [E, C, D]
     h = jnp.einsum("ecd,edh->ech", expert_in, w1) + b1[:, None]
@@ -144,15 +155,8 @@ def moe_ffn_ep(x, gate_w, w1, b1, w2, b2, mesh, k: int = 2,
         probs = jax.nn.softmax(logits, axis=-1)
         topv, topi = jax.lax.top_k(probs, kk)
         gates = topv / jnp.maximum(jnp.sum(topv, -1, keepdims=True), 1e-9)
-        onehot_i = jax.nn.one_hot(topi, e, dtype=jnp.int32)
-        flat = onehot_i.reshape(n_l * kk, e)
-        pos = (jnp.cumsum(flat, axis=0) * flat - flat).reshape(n_l, kk, e)
-        keep = ((pos < cap) & (onehot_i > 0)).astype(x_l.dtype)
-        slot = jax.nn.one_hot(pos, cap, dtype=x_l.dtype)
-        disp_k = slot * keep[..., None]
-        dispatch = jnp.sum(disp_k, axis=1)                   # [n_l, E, C]
-        combine = jnp.sum(disp_k * gates.astype(x_l.dtype)[..., None, None],
-                          axis=1)
+        dispatch, combine = _topk_dispatch(topi, gates, e, cap,
+                                           x_l.dtype)      # [n_l, E, C]
 
         expert_in = jnp.einsum("nec,nd->ecd", dispatch, x_l)  # [E, C, D]
         # all-to-all: split the expert dim over the expert axis, gather

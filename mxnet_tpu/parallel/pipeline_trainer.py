@@ -453,12 +453,17 @@ class PipelineTrainer:
         envs = [[None] * S for _ in range(M)]  # env entering stage s
         heads_js = [[None] * S for _ in range(M)]
         aux = [dict(a) for a in self._aux]
+        # per-microbatch aux snapshot: backward remat must re-run each
+        # stage with the SAME aux its real forward saw, not the
+        # post-all-microbatches value (advisor r3 finding)
+        aux_snap = [[None] * S for _ in range(M)]
         for j in range(M):
             env: Dict[str, jax.Array] = {}
             for s in range(S):
                 env = {k: jax.device_put(v, self.devices[s])
                        for k, v in env.items()}
                 envs[j][s] = env
+                aux_snap[j][s] = aux[s]
                 env, heads_s, aux_up = self._fwd[s](
                     self._params[s], aux[s], env, inputs[s][j], rngs[j][s])
                 if aux_up:
@@ -473,8 +478,8 @@ class PipelineTrainer:
                 ct_env = {k: jax.device_put(v, self.devices[s])
                           for k, v in ct_env.items()}
                 gp, genv = self._bwd[s](
-                    self._params[s], aux[s], envs[j][s], inputs[s][j],
-                    rngs[j][s], ct_env)
+                    self._params[s], aux_snap[j][s], envs[j][s],
+                    inputs[s][j], rngs[j][s], ct_env)
                 ct_env = genv
                 if grads[s] is None:
                     grads[s] = gp

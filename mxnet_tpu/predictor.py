@@ -167,11 +167,15 @@ def create(prefix: str, epoch: int, input_shapes, ctx=None,
 # symbol machinery, no params file (see `load_exported`, and the test
 # that serves it from a subprocess importing only jax).
 
-_EXPORT_MAGIC = b"MXTPUEXP1"
+# V2 header entries are [name, shape, dtype]; V1 were [name, shape]
+# (implied f32).  The reader accepts both; the magic bump keeps OLD
+# readers from mis-parsing NEW artifacts.
+_EXPORT_MAGIC = b"MXTPUEXP2"
+_EXPORT_MAGICS = (b"MXTPUEXP1", b"MXTPUEXP2")
 
 
 def export_model(symbol, arg_params, aux_params, input_shapes,
-                 out_path: str) -> None:
+                 out_path: str, input_dtypes=None) -> None:
     """Serialize a forward-only model into a single deployable artifact.
 
     Parameters
@@ -180,6 +184,10 @@ def export_model(symbol, arg_params, aux_params, input_shapes,
         ``model.load_checkpoint``).
     input_shapes : dict name -> shape of every data input.
     out_path : file or ``scheme://`` URI to write.
+    input_dtypes : dict name -> dtype, optional
+        Input dtypes to trace with (default float32).  Integer inputs
+        (token ids) should pass e.g. ``{"data": "int32"}`` so the
+        artifact preserves the true dtype end to end.
     """
     import json
     import struct as _struct
@@ -214,7 +222,9 @@ def export_model(symbol, arg_params, aux_params, input_shapes,
         return heads
 
     from jax import export as jexport
-    specs = [jax.ShapeDtypeStruct(tuple(input_shapes[n]), jnp.float32)
+    dtypes = {n: jnp.dtype((input_dtypes or {}).get(n, jnp.float32))
+              for n in input_names}
+    specs = [jax.ShapeDtypeStruct(tuple(input_shapes[n]), dtypes[n])
              for n in input_names]
     # lower for every mainstream platform so the artifact serves
     # anywhere; Pallas kernels don't cross-lower, so trace with the
@@ -228,7 +238,8 @@ def export_model(symbol, arg_params, aux_params, input_shapes,
         _nn_ops._DISABLE_PALLAS.pop()
     blob = exp.serialize()
     header = json.dumps({
-        "inputs": [[n, list(input_shapes[n])] for n in input_names],
+        "inputs": [[n, list(input_shapes[n]), str(dtypes[n])]
+                   for n in input_names],
         "num_outputs": len(symbol.list_outputs()),
     }).encode()
     with open_uri(out_path, "wb") as f:
@@ -247,16 +258,20 @@ class ExportedPredictor:
         from jax import export as jexport
         from .stream import open_uri
         with open_uri(path, "rb") as f:
-            if f.read(len(_EXPORT_MAGIC)) != _EXPORT_MAGIC:
+            if f.read(len(_EXPORT_MAGIC)) not in _EXPORT_MAGICS:
                 raise MXNetError(f"{path}: not an exported model")
             (hlen,) = _struct.unpack("<i", f.read(4))
             meta = json.loads(f.read(hlen).decode())
             self._exported = jexport.deserialize(f.read())
-        self.input_names = [n for n, _ in meta["inputs"]]
-        self.input_shapes = {n: tuple(s) for n, s in meta["inputs"]}
+        entries = [(e[0], e[1], e[2] if len(e) > 2 else "float32")
+                   for e in meta["inputs"]]
+        self.input_names = [n for n, _, _ in entries]
+        self.input_shapes = {n: tuple(s) for n, s, _ in entries}
+        self.input_dtypes = {n: np.dtype(d) for n, _, d in entries}
 
     def predict(self, **inputs) -> List[np.ndarray]:
-        args = [np.asarray(inputs[n], np.float32) for n in self.input_names]
+        args = [np.asarray(inputs[n], self.input_dtypes[n])
+                for n in self.input_names]
         return [np.asarray(o) for o in self._exported.call(*args)]
 
 

@@ -1,0 +1,76 @@
+"""LR precedence matrix: scheduler base_lr x WarmupScheduler wrapper x
+optimizer learning_rate (advisor r3 + review findings).
+
+Rules under test:
+  1. explicit optimizer learning_rate outranks everything, including an
+     explicitly-constructed inner scheduler behind a warmup wrapper
+     (propagated so the warmup->after transition stays continuous);
+  2. with no optimizer lr, an explicit scheduler base_lr wins and
+     backfills optimizer.lr;
+  3. implicit everywhere falls back to the optimizer-class default;
+  4. wrapper-implicit + inner-explicit: the wrapper adopts the inner's
+     base_lr as the ramp peak (continuity);
+  5. wrapper-explicit + inner-explicit (no optimizer lr): both honored —
+     the user asked for a jump.
+"""
+import pytest
+
+from mxnet_tpu import optimizer as opt
+from mxnet_tpu.lr_scheduler import (CosineScheduler, FactorScheduler,
+                                    WarmupScheduler)
+
+
+def test_optimizer_lr_wins_over_explicit_inner():
+    o = opt.create("sgd", learning_rate=0.1,
+                   lr_scheduler=WarmupScheduler(
+                       10, after=CosineScheduler(100, base_lr=0.01)))
+    s = o.lr_scheduler
+    assert s(9) == pytest.approx(0.1)          # ramp peaks at optimizer lr
+    assert s(10) == pytest.approx(0.1, rel=1e-3)  # continuous into cosine
+
+
+def test_optimizer_lr_wins_over_explicit_flat_scheduler():
+    o = opt.create("sgd", learning_rate=0.05,
+                   lr_scheduler=CosineScheduler(100, base_lr=3e-4))
+    assert o.lr_scheduler.base_lr == pytest.approx(0.05)
+
+
+def test_explicit_scheduler_backfills_optimizer_lr():
+    o = opt.create("sgd", lr_scheduler=CosineScheduler(100, base_lr=3e-4))
+    assert o.lr == pytest.approx(3e-4)
+    assert o.lr_scheduler.base_lr == pytest.approx(3e-4)
+
+
+def test_implicit_everywhere_uses_class_default():
+    assert opt.create("sgd").lr == pytest.approx(0.01)
+    assert opt.create("adam").lr == pytest.approx(0.001)
+    assert opt.create("rmsprop").lr == pytest.approx(0.002)
+    o = opt.create("sgd", lr_scheduler=FactorScheduler(step=5, factor=0.5))
+    assert o.lr_scheduler.base_lr == pytest.approx(0.01)
+
+
+def test_wrapper_implicit_adopts_explicit_inner():
+    s = WarmupScheduler(10, after=CosineScheduler(90, base_lr=0.001))
+    assert s(9) == pytest.approx(0.001)            # ramp peak = inner lr
+    assert s(10) == pytest.approx(0.001, rel=1e-3)  # no discontinuity
+
+
+def test_both_explicit_without_optimizer_jump_is_honored():
+    s = WarmupScheduler(10, after=CosineScheduler(100, base_lr=0.3),
+                        base_lr=0.1)
+    assert s(9) == pytest.approx(0.1)
+    assert s(10) == pytest.approx(0.3, rel=1e-3)
+
+
+def test_warmup_propagates_optimizer_lr_to_implicit_inner():
+    o = opt.create("sgd", learning_rate=0.2,
+                   lr_scheduler=WarmupScheduler(
+                       10, after=FactorScheduler(step=50, factor=0.5)))
+    assert o.lr_scheduler(12) == pytest.approx(0.2)
+
+
+def test_explicit_inner_behind_warmup_backfills_optimizer_lr():
+    o = opt.create("sgd", lr_scheduler=WarmupScheduler(
+        10, after=CosineScheduler(100, base_lr=3e-4)))
+    assert o.lr == pytest.approx(3e-4)
+    assert o.lr_scheduler(9) == pytest.approx(3e-4)

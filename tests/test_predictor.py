@@ -98,7 +98,7 @@ import numpy as np
 import jax
 from jax import export as jexport
 with open({out!r}, 'rb') as f:
-    assert f.read(9) == b'MXTPUEXP1'
+    assert f.read(9) == b'MXTPUEXP2'  # V2: header entries carry dtype
     (hlen,) = struct.unpack('<i', f.read(4))
     meta = json.loads(f.read(hlen).decode())
     exp = jexport.deserialize(f.read())
@@ -114,3 +114,70 @@ print('served ok')
     assert r.returncode == 0, r.stderr
     y_sub = np.load(str(tmp_path / "y.npy"))
     np.testing.assert_allclose(y_sub, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_export_model_int_dtype(tmp_path):
+    """V2 artifacts preserve integer input dtypes (advisor r3 finding):
+    an Embedding model exports with int32 token ids end to end."""
+    import mxnet_tpu as mx
+    import numpy as np
+
+    emb = mx.symbol.Embedding(data=mx.symbol.Variable("data"),
+                              input_dim=20, output_dim=6, name="emb")
+    net = mx.symbol.SoftmaxOutput(
+        data=mx.symbol.FullyConnected(data=mx.symbol.Flatten(emb),
+                                      num_hidden=3, name="fc"),
+        name="softmax")
+    rng = np.random.RandomState(3)
+    arg = {"emb_weight": mx.nd.array(rng.randn(20, 6).astype(np.float32)),
+           "fc_weight": mx.nd.array(rng.randn(3, 4 * 6).astype(np.float32)),
+           "fc_bias": mx.nd.array(np.zeros(3, np.float32))}
+    out = str(tmp_path / "emb.mxtpu")
+    from mxnet_tpu.predictor import export_model, load_exported
+    export_model(net, arg, {}, {"data": (2, 4)}, out,
+                 input_dtypes={"data": "int32"})
+    pred = load_exported(out)
+    assert pred.input_dtypes["data"] == np.dtype("int32")
+    ids = np.array([[1, 2, 3, 4], [19, 0, 7, 5]], np.int64)  # cast to i32
+    y = pred.predict(data=ids)[0]
+    assert y.shape == (2, 3)
+    np.testing.assert_allclose(y.sum(axis=1), 1.0, rtol=1e-5)
+
+
+def test_export_v1_artifact_still_loads(tmp_path):
+    """Reader back-compat: a V1 artifact (2-tuple header entries, implied
+    f32, MXTPUEXP1 magic) still deserializes and serves."""
+    import struct
+
+    import mxnet_tpu as mx
+    import numpy as np
+
+    net = mx.symbol.SoftmaxOutput(
+        data=mx.symbol.FullyConnected(data=mx.symbol.Variable("data"),
+                                      num_hidden=3, name="fc"),
+        name="softmax")
+    rng = np.random.RandomState(4)
+    arg = {"fc_weight": mx.nd.array(rng.randn(3, 5).astype(np.float32)),
+           "fc_bias": mx.nd.array(np.zeros(3, np.float32))}
+    v2 = str(tmp_path / "m2.mxtpu")
+    from mxnet_tpu.predictor import export_model, load_exported
+    export_model(net, arg, {}, {"data": (2, 5)}, v2)
+    # rewrite as a V1 artifact: old magic + 2-tuple entries
+    import json
+    with open(v2, "rb") as f:
+        assert f.read(9) == b"MXTPUEXP2"
+        (hlen,) = struct.unpack("<i", f.read(4))
+        meta = json.loads(f.read(hlen).decode())
+        blob = f.read()
+    meta["inputs"] = [[n, s] for n, s, _ in meta["inputs"]]
+    hdr = json.dumps(meta).encode()
+    v1 = str(tmp_path / "m1.mxtpu")
+    with open(v1, "wb") as f:
+        f.write(b"MXTPUEXP1")
+        f.write(struct.pack("<i", len(hdr)))
+        f.write(hdr)
+        f.write(blob)
+    pred = load_exported(v1)
+    assert pred.input_dtypes["data"] == np.dtype("float32")
+    y = pred.predict(data=rng.rand(2, 5).astype(np.float64))[0]
+    assert y.shape == (2, 3)
