@@ -66,29 +66,46 @@ def _attention_shape(params, in_shapes):
 
 
 def _moe_ffn_fwd(ctx, params, x, gate_w, w1, b1, w2, b2):
-    from ..parallel.moe import moe_ffn, switch_ffn
+    from ..parallel.mesh import current_mesh
+    from ..parallel.moe import (load_balance_loss, moe_ffn, moe_ffn_ep,
+                                switch_ffn)
     orig = x.shape
     if x.ndim > 2:
         x = x.reshape(-1, orig[-1])
-    if params["top_k"] <= 1:
-        y, _ = switch_ffn(x, gate_w, w1, b1, w2, b2,
-                          capacity_factor=params["capacity_factor"])
+    eax = params["expert_axis"]
+    mesh = current_mesh()
+    if (mesh is not None and eax in mesh.axis_names
+            and mesh.shape[eax] > 1):
+        # expert axis active: the explicit all-to-all EP program (same
+        # mesh-aware switch RingAttention does for the seq axis)
+        y, probs = moe_ffn_ep(x, gate_w, w1, b1, w2, b2, mesh,
+                              k=max(1, params["top_k"]),
+                              capacity_factor=params["capacity_factor"],
+                              expert_axis=eax,
+                              data_axis=params["data_axis"])
+    elif params["top_k"] <= 1:
+        y, probs = switch_ffn(x, gate_w, w1, b1, w2, b2,
+                              capacity_factor=params["capacity_factor"])
     else:
-        y, _ = moe_ffn(x, gate_w, w1, b1, w2, b2, k=params["top_k"],
-                       capacity_factor=params["capacity_factor"])
-    return y.reshape(orig)
+        y, probs = moe_ffn(x, gate_w, w1, b1, w2, b2, k=params["top_k"],
+                           capacity_factor=params["capacity_factor"])
+    y = y.reshape(orig)
+    if params["aux_loss"]:
+        return y, load_balance_loss(probs)
+    return y
 
 
 def _moe_ffn_shape(params, in_shapes):
     shapes = list(in_shapes) + [None] * (6 - len(in_shapes))
     d = shapes[0]
     if d is None:
-        return shapes, [None], []
+        return shapes, [None, ()] if params["aux_loss"] else [None], []
     e = params["num_experts"]
     h = params["hidden_size"]
     dm = d[-1]
+    outs = [tuple(d), ()] if params["aux_loss"] else [tuple(d)]
     return ([tuple(d), (dm, e), (e, dm, h), (e, h), (e, h, dm), (e, dm)],
-            [tuple(d)], [])
+            outs, [])
 
 
 register_op(OpDef(
@@ -96,16 +113,25 @@ register_op(OpDef(
     forward=_moe_ffn_fwd,
     arguments=("data", "gate_weight", "expert1_weight", "expert1_bias",
                "expert2_weight", "expert2_bias"),
+    outputs=lambda p: (["output", "aux_loss"] if p["aux_loss"]
+                       else ["output"]),
     params={
         "num_experts": OpParam("num_experts", "int", required=True),
         "hidden_size": OpParam("hidden_size", "int", required=True),
         "capacity_factor": OpParam("capacity_factor", "float", default=1.5),
         "top_k": OpParam("top_k", "int", default=1),
+        "expert_axis": OpParam("expert_axis", "str", default="expert"),
+        "data_axis": OpParam("data_axis", "str", default="data"),
+        "aux_loss": OpParam("aux_loss", "bool", default=False,
+                            doc="emit the Switch load-balance auxiliary "
+                                "loss as a second (scalar) output"),
     },
     infer_shape=_moe_ffn_shape,
-    doc="Top-k mixture-of-experts feed-forward (top_k=1: Switch, 2: GShard); shard the "
-        "expert_* leading dim over the expert mesh axis for expert "
-        "parallelism.",
+    doc="Top-k mixture-of-experts feed-forward (top_k=1: Switch, 2: "
+        "GShard).  When the active default mesh has an ``expert_axis`` "
+        "of size > 1, lowers to the explicit-all-to-all expert-parallel "
+        "program (parallel/moe.py:moe_ffn_ep); otherwise dense "
+        "dispatch/combine einsums.",
 ))
 
 

@@ -127,9 +127,16 @@ def moe_ffn_ep(x, gate_w, w1, b1, w2, b2, mesh, k: int = 2,
     routed tokens, not to the full activation tensor.
 
     ``x`` must be sharded ``P((data_axis, expert_axis), None)`` — tokens
-    split over ALL chips, the canonical EP layout; expert weights
+    split over ALL chips, the canonical EP layout (``P(expert_axis,
+    None)`` when the mesh has no data axis); expert weights
     ``P(expert_axis, ...)`` (replicated over ``data``, so their grads
-    psum over it in the transpose).  Returns ``y`` sharded like ``x``.
+    psum over it in the transpose).  Returns ``(y, router_probs)``, both
+    sharded like ``x`` on the token dim.
+
+    ``k=1`` uses the Switch gate convention (scale by the router
+    probability itself) so this is an exact expert-parallel lowering of
+    :func:`switch_ffn`; ``k>1`` renormalizes over the chosen k like
+    :func:`moe_ffn`.
     """
     from jax.experimental.shard_map import shard_map
     from functools import partial
@@ -140,13 +147,16 @@ def moe_ffn_ep(x, gate_w, w1, b1, w2, b2, mesh, k: int = 2,
     if e % ep:
         raise ValueError(f"num_experts {e} not divisible by expert-axis "
                          f"size {ep}")
+    tok_axes = tuple(a for a in (data_axis, expert_axis)
+                     if a in mesh.axis_names)
+    tok_spec = P(tok_axes, None)
 
     @partial(
         shard_map, mesh=mesh,
-        in_specs=(P((data_axis, expert_axis), None), P(),
+        in_specs=(tok_spec, P(),
                   P(expert_axis, None, None), P(expert_axis, None),
                   P(expert_axis, None, None), P(expert_axis, None)),
-        out_specs=P((data_axis, expert_axis), None))
+        out_specs=(tok_spec, tok_spec))
     def fn(x_l, gw, w1_l, b1_l, w2_l, b2_l):
         n_l, d = x_l.shape
         kk = min(k, e)
@@ -154,7 +164,11 @@ def moe_ffn_ep(x, gate_w, w1, b1, w2, b2, mesh, k: int = 2,
         logits = jnp.dot(x_l, gw)
         probs = jax.nn.softmax(logits, axis=-1)
         topv, topi = jax.lax.top_k(probs, kk)
-        gates = topv / jnp.maximum(jnp.sum(topv, -1, keepdims=True), 1e-9)
+        if kk == 1:
+            gates = topv  # Switch convention: scale by the router prob
+        else:
+            gates = topv / jnp.maximum(jnp.sum(topv, -1, keepdims=True),
+                                       1e-9)
         dispatch, combine = _topk_dispatch(topi, gates, e, cap,
                                            x_l.dtype)      # [n_l, E, C]
 
@@ -169,7 +183,7 @@ def moe_ffn_ep(x, gate_w, w1, b1, w2, b2, mesh, k: int = 2,
         # reverse all-to-all: send each peer its tokens' results back
         back = jax.lax.all_to_all(out, expert_axis, split_axis=1,
                                   concat_axis=0, tiled=True)  # [E, C, D]
-        return jnp.einsum("nec,ecd->nd", combine, back)
+        return jnp.einsum("nec,ecd->nd", combine, back), probs
 
     return fn(x, gate_w, w1, b1, w2, b2)
 

@@ -106,3 +106,63 @@ def test_expert_parallel_equivalence():
     for n in params_1:
         np.testing.assert_allclose(params_ep[n], params_1[n], rtol=2e-4,
                                    atol=2e-4, err_msg=n)
+
+
+def test_symbol_moe_lowers_to_explicit_all_to_all():
+    """VERDICT r3 item 5: when the trainer mesh has an expert axis, the
+    Symbol-level MoEFFN must reach the explicit all-to-all EP program
+    (moe_ffn_ep), not the GSPMD-guess dense dispatch."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    import re
+
+    from mxnet_tpu.parallel.mesh import default_mesh
+    b, d = 16, 8
+    net = sym.MoEFFN(data=sym.Variable("data"), num_experts=4,
+                     hidden_size=16, capacity_factor=4.0, top_k=2,
+                     name="moe")
+    net = sym.LinearRegressionOutput(data=net, name="lro")
+    mesh = make_mesh({"data": 2, "expert": 4})
+    rules = ShardingRules([
+        (r"moe_expert\d_(weight|bias)", P("expert")),
+    ])
+    t = ShardedTrainer(net, optimizer="sgd", mesh=mesh, rules=rules)
+    t.bind(data_shapes={"data": (b, d)}, label_shapes={"lro_label": (b, d)})
+    rng = np.random.RandomState(0)
+    placed = t._place_batch({"data": rng.rand(b, d).astype(np.float32),
+                             "lro_label": rng.rand(b, d).astype(np.float32)})
+    with default_mesh(mesh):
+        hlo = t._train_step.lower(t._params, t._aux, t._opt_state, placed,
+                                  0.1, 1).compile().as_text()
+    assert re.search(r"all-to-all", hlo), \
+        "Symbol MoEFFN did not lower to the explicit all-to-all EP program"
+
+
+def test_moe_aux_loss_head_trains_balance():
+    """aux_loss=True emits the Switch load-balance loss as a second head;
+    grouped with the task loss it pushes routing toward uniform."""
+    b, d = 32, 8
+    moe = sym.MoEFFN(data=sym.Variable("data"), num_experts=4,
+                     hidden_size=16, capacity_factor=4.0, top_k=2,
+                     aux_loss=True, name="moe")
+    net = sym.Group([sym.LinearRegressionOutput(data=moe[0], name="lro"),
+                     moe[1]])
+    t = ShardedTrainer(net, optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.05},
+                       mesh=make_mesh({"data": 1},
+                                      devices=jax.devices()[:1]))
+    t.bind(data_shapes={"data": (b, d)}, label_shapes={"lro_label": (b, d)})
+    rng = np.random.RandomState(9)
+    X = rng.randn(b, d).astype(np.float32)
+    Y = rng.randn(b, d).astype(np.float32)
+    first = None
+    for i in range(30):
+        out = t.step({"data": X, "lro_label": Y})
+        bal = float(np.asarray(out[1]))
+        if first is None:
+            first = bal
+    assert np.isfinite(bal)
+    # load-balance loss is minimized at 1.0 (uniform); training with the
+    # aux head must move it toward 1 (or keep it there)
+    assert bal <= first + 1e-3, (first, bal)
+    assert bal < 1.5, bal

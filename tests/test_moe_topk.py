@@ -81,7 +81,7 @@ def test_ep_all_to_all_materializes_and_matches_dense():
     b2_ = place(b2, P("expert", None))
 
     f = jax.jit(lambda *a: moe_ffn_ep(*a, mesh=mesh, k=2,
-                                      capacity_factor=8.0))
+                                      capacity_factor=8.0)[0])
     hlo = f.lower(x, gw_, w1_, b1_, w2_, b2_).compile().as_text()
     assert re.search(r"all-to-all", hlo), \
         "expert all-to-all missing from compiled HLO"
@@ -109,7 +109,7 @@ def test_ep_gradients_flow():
 
     @jax.jit
     def loss(params, x):
-        y = moe_ffn_ep(x, *params, mesh=mesh, k=2, capacity_factor=8.0)
+        y, _ = moe_ffn_ep(x, *params, mesh=mesh, k=2, capacity_factor=8.0)
         return jnp.sum(jnp.square(y))
 
     grads = jax.jit(jax.grad(loss))(params, x)
