@@ -265,12 +265,35 @@ class ShardedTrainer:
         else:
             batch0 = next(iter(data_shapes.values()))[0]
             self._rescale_grad = 1.0 / float(batch0)
-        self._zero_specs = {n: self._zero_spec(n, shape_of[n])
-                            for n in self._param_names}
-        opt_state = {n: jax.tree.map(
-            lambda z, _n=n: self._global_put(
-                z, NamedSharding(self.mesh, self._zero_specs[_n])),
-            opt.state_zeros_like(params[n])) for n in self._param_names}
+        plans = {n: self._zero_plan(n, shape_of[n])
+                 for n in self._param_names}
+        self._zero_specs = {n: p[0] for n, p in plans.items()}
+        self._zero_flat = {n: p[1] for n, p in plans.items()}
+        if self.shard_optimizer and self.data_axis is not None:
+            rule_sharded = [n for n in self._param_names
+                            if any(ax is not None
+                                   for ax in self.rules.spec_for(n))]
+            dim_sharded = [n for n, (sp, fl) in plans.items()
+                           if fl is None and n not in rule_sharded
+                           and any(ax is not None for ax in sp)]
+            flat = [n for n, (_, fl) in plans.items() if fl is not None]
+            left = [n for n in self._param_names
+                    if n not in rule_sharded and n not in dim_sharded
+                    and n not in flat]
+            self.logger.info(
+                "ZeRO: %d params dim-sharded, %d flatten-pad-sharded, "
+                "%d TP-rule-sharded, %d replicated%s", len(dim_sharded),
+                len(flat), len(rule_sharded), len(left),
+                (" (" + ", ".join(left) + ")") if left else "")
+        opt_state = {}
+        for n in self._param_names:
+            flat_len = self._zero_flat[n]
+            template = (jnp.zeros((flat_len,), params[n].dtype)
+                        if flat_len is not None else params[n])
+            opt_state[n] = jax.tree.map(
+                lambda z, _n=n: self._global_put(
+                    z, NamedSharding(self.mesh, self._zero_specs[_n])),
+                opt.state_zeros_like(template))
 
         self._params, self._aux, self._opt_state = params, aux, opt_state
         self._num_update = opt.begin_num_update
@@ -287,24 +310,34 @@ class ShardedTrainer:
         self._bound = True
         return self
 
-    def _zero_spec(self, name: str, shape: Tuple[int, ...]) -> P:
-        """Placement for the optimizer state (and in-step update) of one
-        param.  Without ZeRO this is the param's own rule spec.  With ZeRO,
-        rule-replicated params get their first data-axis-divisible dim
-        sharded over ``data``; TP-sharded params keep their rule spec (they
-        are already distributed)."""
+    def _zero_plan(self, name: str,
+                   shape: Tuple[int, ...]) -> Tuple[P, Optional[int]]:
+        """Placement plan for the optimizer state (and in-step update) of
+        one param: ``(spec, flat_padded_len)``.  Without ZeRO the spec is
+        the param's own rule spec (flat None).  With ZeRO, rule-replicated
+        params get their first data-axis-divisible dim sharded over
+        ``data``; params with NO divisible dim (biases, BN scales) fall
+        back to a FLATTEN-AND-PAD layout — state lives as a 1-D array
+        padded to a multiple of the data-axis size and sharded ``P(data)``
+        — so at pod scale nothing stays replicated.  TP-sharded params
+        keep their rule spec (already distributed)."""
         rule_spec = self.rules.spec_for(name)
         if not self.shard_optimizer or self.data_axis is None:
-            return rule_spec
+            return rule_spec, None
         if any(ax is not None for ax in rule_spec):
-            return rule_spec
+            return rule_spec, None
         n = self.mesh.shape[self.data_axis]
         for dim, size in enumerate(shape):
             if size % n == 0 and size > 0:
                 spec = [None] * len(shape)
                 spec[dim] = self.data_axis
-                return P(*spec)
-        return rule_spec  # too small/indivisible: stays replicated
+                return P(*spec), None
+        numel = int(np.prod(shape)) if shape else 1
+        padded = -(-numel // n) * n  # ceil to a multiple of the data axis
+        return P(self.data_axis), padded
+
+    def _zero_spec(self, name: str, shape: Tuple[int, ...]) -> P:
+        return self._zero_plan(name, shape)[0]
 
     def optimizer_state_bytes_per_device(self) -> int:
         """Per-chip bytes held by optimizer state (the ZeRO savings gauge)."""
@@ -341,6 +374,7 @@ class ShardedTrainer:
                 if self.shard_optimizer
                 and self._zero_specs[n] != self.rules.spec_for(n) else None)
             for n in param_names}
+        zero_flat = dict(self._zero_flat)
 
         cdt = self.compute_dtype
 
@@ -408,6 +442,16 @@ class ShardedTrainer:
             for i, n in enumerate(param_names):
                 prng = jax.random.fold_in(rng, i) if needs_rng else None
                 w, g = params[n], grads[n]
+                flat_len = zero_flat[n]
+                if flat_len is not None:
+                    # ZeRO flatten-and-pad: indivisible params (biases,
+                    # BN scales) update in a padded 1-D layout sharded
+                    # over data; the zero-padded tail stays zero under
+                    # every elementwise optimizer (g=0, w=0)
+                    shape = w.shape
+                    pad = flat_len - int(np.prod(shape))
+                    w = jnp.pad(w.reshape(-1), (0, pad))
+                    g = jnp.pad(g.reshape(-1), (0, pad))
                 if zero_shardings[n] is not None:
                     # ZeRO: constrain grad + weight to the data-sharded
                     # spec — XLA emits reduce-scatter for the grad sum and
@@ -419,6 +463,8 @@ class ShardedTrainer:
                 w2, s2 = step_fn(hyper, w, g, opt_state[n],
                                  lr * lr_mult[n], base_wd * wd_mult[n],
                                  t, prng)
+                if flat_len is not None:
+                    w2 = w2[:int(np.prod(shape))].reshape(shape)
                 new_params[n] = w2
                 new_opt[n] = s2
             new_aux = dict(aux)

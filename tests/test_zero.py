@@ -95,16 +95,43 @@ def test_zero_shards_state_bytes():
             shard, leaf.shape, n_dev)
 
 
-def test_zero_spec_skips_indivisible():
-    """Params with no data-axis-divisible dim stay replicated."""
+def test_zero_indivisible_params_flatten_pad():
+    """Params with no data-axis-divisible dim shard via flatten-and-pad
+    instead of staying replicated (VERDICT r3 item 8)."""
     import jax
     shapes = {"data": (16, 32), "softmax_label": (16,)}
     sym = _mlp()
     t = _make(True, _init_params(sym, shapes), shapes)
-    # fc2_bias has shape (10,): not divisible by 8 -> replicated
+    # fc2_bias has shape (10,): not divisible by 8 -> flat pad to 16
     from jax.sharding import PartitionSpec as P
-    assert t._zero_specs["fc2_bias"] == P()
-    assert t._zero_specs["fc1_weight"] != P()
+    n_dev = len(jax.devices())
+    assert t._zero_specs["fc2_bias"] == P("data")
+    assert t._zero_flat["fc2_bias"] == -(-10 // n_dev) * n_dev
+    assert t._zero_flat["fc1_weight"] is None  # dim-sharded, no pad
+    # the flat state actually lives sharded: per-chip = padded/N
+    for leaf in jax.tree.leaves(t._opt_state["fc2_bias"]):
+        assert leaf.shape == (t._zero_flat["fc2_bias"],)
+        shard = leaf.sharding.shard_shape(leaf.shape)
+        assert int(np.prod(shard)) == leaf.size // n_dev
+
+
+def test_zero_replicated_state_under_5pct():
+    """With the flatten-pad fallback, replicated optimizer bytes must be
+    < 5% of total state (here: zero — everything shards)."""
+    import jax
+    shapes = {"data": (16, 32), "softmax_label": (16,)}
+    sym = _mlp()
+    t = _make(True, _init_params(sym, shapes), shapes)
+    replicated = total = 0
+    for st in t._opt_state.values():
+        for leaf in jax.tree.leaves(st):
+            nbytes = leaf.size * leaf.dtype.itemsize
+            total += nbytes
+            shard = int(np.prod(leaf.sharding.shard_shape(leaf.shape)))
+            if shard == leaf.size and leaf.size > 1:
+                replicated += nbytes
+    assert total > 0
+    assert replicated / total < 0.05, (replicated, total)
 
 
 def test_zero_composes_with_megatron_tp():
