@@ -90,6 +90,11 @@ def megatron_rules(model_axis: str = "model") -> ShardingRules:
     ])
 
 
+class _PlacedBatch(dict):
+    """Marker for dicts already staged onto the mesh by ``place_batch`` —
+    ``_place_batch`` passes them through without re-dispatching puts."""
+
+
 class ShardedTrainer:
     """Compiled data/tensor-parallel trainer for a Symbol.
 
@@ -212,6 +217,8 @@ class ShardedTrainer:
                     f"data-axis size {ndata} x grad_accum {self.grad_accum}")
         arg_names = sym.list_arguments()
         self._input_names = [n for n in arg_names if n in input_shapes]
+        self._label_names = [n for n in arg_names
+                             if n in (label_shapes or {})]
         self._param_names = [n for n in arg_names if n not in input_shapes]
         self._aux_names = sym.list_auxiliary_states()
 
@@ -505,13 +512,38 @@ class ShardedTrainer:
             donate_argnums=(0, 1, 2))
         self._eval_step = jax.jit(eval_step)
 
+        # fit()'s fused-metric variant: the Accuracy fold runs INSIDE the
+        # compiled step (zero extra dispatches, zero per-batch host
+        # syncs).  jit is lazy — this never compiles unless fit() uses it.
+        label_names = list(self._label_names)
+
+        def train_step_acc(params, aux, opt_state, batch, lr, t, carry):
+            new_p, new_a, new_o, heads = train_step(params, aux, opt_state,
+                                                    batch, lr, t)
+            c = carry
+            for ln, head in zip(label_names, heads):
+                pred = head
+                if pred.ndim > 1:
+                    pred = jnp.argmax(pred, axis=1)
+                c = c + jnp.sum(pred.astype(jnp.int32).reshape(-1)
+                                == batch[ln].astype(jnp.int32).reshape(-1))
+            return new_p, new_a, new_o, heads, c
+
+        self._train_step_acc = jax.jit(
+            train_step_acc,
+            out_shardings=(p_shard, a_shard, o_shard, None, None),
+            donate_argnums=(0, 1, 2))
+
     # ------------------------------------------------------------------
     # Stepping
     # ------------------------------------------------------------------
 
     def _place_batch(self, batch) -> Dict[str, jax.Array]:
         """Accept a DataBatch / dict / aligned list; shard dim 0 over the
-        data axis."""
+        data axis.  A dict returned by a previous ``place_batch`` passes
+        through untouched (no repeat device_put dispatches)."""
+        if isinstance(batch, _PlacedBatch):
+            return batch
         sh = (batch_sharding(self.mesh, self.data_axis)
               if self.data_axis is not None else replicated(self.mesh))
         if hasattr(batch, "data"):  # DataBatch
@@ -534,7 +566,7 @@ class ShardedTrainer:
                     sh, np.asarray(v))
             else:
                 out[n] = jax.device_put(v, sh)
-        return out
+        return _PlacedBatch(out)
 
     def step(self, batch) -> List[jax.Array]:
         """Run one training step; returns the head outputs (global arrays).
@@ -549,7 +581,7 @@ class ShardedTrainer:
         opt = self.optimizer
         lr = (opt.lr_scheduler(self._num_update) if opt.lr_scheduler
               else opt.lr)
-        placed = self._place_batch(batch)
+        placed = dict(self._place_batch(batch))
         # scope the mesh so mesh-aware ops (RingAttention) pick up the seq
         # axis when this step traces
         with default_mesh(self.mesh), self._precision_scope():
@@ -562,10 +594,25 @@ class ShardedTrainer:
         """Asynchronously stage a batch onto the mesh (prefetch hook)."""
         return self._place_batch(batch)
 
+    def _step_acc(self, batch, carry):
+        """step() variant whose program also folds the Accuracy correct
+        count into ``carry`` — fit()'s zero-extra-dispatch metric path."""
+        self._num_update += 1
+        opt = self.optimizer
+        lr = (opt.lr_scheduler(self._num_update) if opt.lr_scheduler
+              else opt.lr)
+        placed = dict(self._place_batch(batch))
+        with default_mesh(self.mesh), self._precision_scope():
+            self._params, self._aux, self._opt_state, heads, carry = \
+                self._train_step_acc(self._params, self._aux,
+                                     self._opt_state, placed, lr,
+                                     self._num_update, carry)
+        return list(heads), carry
+
     def forward(self, batch) -> List[jax.Array]:
         """Inference forward (no aux update, no dropout)."""
         self._eval_count = getattr(self, "_eval_count", 0) + 1
-        placed = self._place_batch(batch)
+        placed = dict(self._place_batch(batch))
         with default_mesh(self.mesh), self._precision_scope():
             return list(self._eval_step(self._params, self._aux, placed,
                                         self._eval_count))
@@ -589,6 +636,9 @@ class ShardedTrainer:
             if n in self._aux:
                 val = v.data if isinstance(v, NDArray) else jnp.asarray(v)
                 self._aux[n] = self._global_put(val, replicated(self.mesh))
+
+    def _metric_proxy(self, eval_metric):
+        return _AsyncMetric(eval_metric)
 
     def score(self, eval_data, eval_metric):
         from ..metric import create as metric_create
@@ -635,22 +685,54 @@ class ShardedTrainer:
                     " attribute, lr-schedule clock not advanced (set "
                     "optimizer.begin_num_update for exact resume)",
                     begin_epoch)
+        # async metric path (SURVEY §3.3 "Python stays ahead of the
+        # devices"): supported metrics accumulate ON device, others
+        # buffer head references — either way no per-batch host sync;
+        # get()/get_name_value() (e.g. from a Speedometer callback)
+        # drain exactly then
+        am = self._metric_proxy(eval_metric)
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()
-            eval_metric.reset()
+            am.reset()
             nbatch = 0
             train_data.reset()
-            for batch in train_data:
-                outs = self.step(batch)
-                eval_metric.update(batch.label,
-                                   [NDArray(np.asarray(o)) for o in outs])
+            # double-buffered input placement: batch i+1's host->device
+            # transfer is dispatched right after step i, so it overlaps
+            # step i's device compute (the estimator-path analog of
+            # bench.py's place_batch prefetch)
+            it = iter(train_data)
+            batch = next(it, None)
+            placed = self._place_batch(batch) if batch is not None else None
+            fused = am.supports_fused and bool(self._label_names)
+            nheads = len(self.symbol.list_outputs())
+            ninst_names = self._label_names[:nheads]
+            while batch is not None:
+                cur = placed
+                if fused:
+                    # accuracy folds inside the step program: ONE dispatch
+                    # per batch, no extra host<->device traffic at all
+                    outs, carry = self._step_acc(cur, am.take_carry())
+                    am.put_carry(carry, sum(
+                        int(np.prod(cur[n].shape)) for n in ninst_names))
+                else:
+                    outs = self.step(cur)
+                nxt = next(it, None)
+                if nxt is not None:
+                    placed = self._place_batch(nxt)
+                if not fused:
+                    # labels already live on device in the placed batch —
+                    # no second host->device hop for the metric
+                    lbls = ([cur[n] for n in self._label_names]
+                            if self._label_names else batch.label)
+                    am.update_async(lbls, outs)
                 nbatch += 1
                 if batch_end_callback is not None:
                     from ..model import BatchEndParam
                     batch_end_callback(BatchEndParam(
-                        epoch=epoch, nbatch=nbatch, eval_metric=eval_metric,
+                        epoch=epoch, nbatch=nbatch, eval_metric=am,
                         locals=locals()))
-            name, value = eval_metric.get()
+                batch = nxt
+            name, value = am.get()
             names = name if isinstance(name, list) else [name]
             values = value if isinstance(value, list) else [value]
             for n_, v_ in zip(names, values):
@@ -665,3 +747,123 @@ class ShardedTrainer:
                 for name, value in [m.get()]:
                     self.logger.info("Epoch[%d] Mesh-Validation-%s=%s",
                                      epoch, name, value)
+
+
+# ---------------------------------------------------------------------------
+# Async metric accumulation (fit() hot path)
+# ---------------------------------------------------------------------------
+
+@jax.jit
+def _acc_fold1(carry, pred, label):
+    """Device-side Accuracy.update for one (pred, label) pair folded into
+    the carried correct-count scalar: one small async dispatch per batch
+    (instance counts are static)."""
+    if pred.ndim > 1:
+        pred = jnp.argmax(pred, axis=1)
+    p = pred.astype(jnp.int32).reshape(-1)
+    l = label.astype(jnp.int32).reshape(-1)
+    return carry + jnp.sum(p == l)
+
+
+class _AsyncMetric:
+    """Metric facade that never forces a device->host sync per batch.
+
+    The reference keeps Python ahead of its engine by making metric reads
+    lazy on engine completion (SURVEY §3.3); the XLA analog: ``Accuracy``
+    folds into a carried on-device scalar (one tiny async add per batch),
+    any other metric buffers head references and replays them into the
+    wrapped metric every ``period`` batches (period sized so the buffer
+    holds <= ~64 MB of head outputs).  ``get``/``get_name_value``/
+    ``get_metric`` drain first, so Speedometer-cadence callbacks observe
+    exact values at their own frequency and the training loop pays the
+    sync only there.
+    """
+
+    _MAX_BUFFER_BYTES = 64 << 20
+
+    def __init__(self, inner):
+        from ..metric import Accuracy
+        self.inner = inner
+        self._on_device = type(inner) is Accuracy
+        self._dev_sum = None   # carried device scalar (correct count)
+        self._dev_num = 0      # static instance count
+        self._buf: List[Tuple[Any, Any]] = []
+        self._period: Optional[int] = None
+
+    # -- fused path (the correct-count fold runs inside the train step) --
+
+    @property
+    def supports_fused(self):
+        return self._on_device
+
+    def take_carry(self):
+        c = self._dev_sum if self._dev_sum is not None else jnp.int32(0)
+        self._dev_sum = None
+        return c
+
+    def put_carry(self, carry, ninst: int):
+        self._dev_sum = carry
+        self._dev_num += ninst
+
+    # -- EvalMetric surface ------------------------------------------------
+
+    @property
+    def name(self):
+        return self.inner.name
+
+    def reset(self):
+        self.inner.reset()
+        self._dev_sum = None
+        self._dev_num = 0
+        self._buf.clear()
+
+    def update(self, labels, preds):  # direct use falls through
+        self.inner.update(labels, preds)
+
+    def get(self):
+        self._drain()
+        return self.inner.get()
+
+    def get_name_value(self):
+        self._drain()
+        return self.inner.get_name_value()
+
+    def get_metric(self, index):
+        self._drain()
+        return self.inner.get_metric(index)
+
+    # -- async accumulation ------------------------------------------------
+
+    def update_async(self, labels, outs):
+        labels = list(labels) if isinstance(labels, (list, tuple)) \
+            else [labels]
+        if self._period is None:
+            nbytes = sum(int(np.prod(o.shape)) * o.dtype.itemsize
+                         for o in outs) or 1
+            self._period = max(1, min(32, self._MAX_BUFFER_BYTES // nbytes))
+        if self._on_device:
+            for label, pred in zip(labels, outs):
+                lv = label.data if isinstance(label, NDArray) \
+                    else jnp.asarray(np.asarray(label))
+                carry = (self._dev_sum if self._dev_sum is not None
+                         else jnp.int32(0))
+                self._dev_sum = _acc_fold1(carry, pred, lv)
+                self._dev_num += int(np.prod(lv.shape))
+            return
+        self._buf.append(([np.asarray(l.asnumpy() if isinstance(l, NDArray)
+                                      else l) for l in labels], list(outs)))
+        if len(self._buf) >= self._period:
+            self._drain()
+
+    def _drain(self):
+        if self._on_device:
+            if self._dev_sum is not None:
+                self.inner.sum_metric += int(np.asarray(self._dev_sum))
+                self.inner.num_inst += self._dev_num
+                self._dev_sum = None
+                self._dev_num = 0
+            return
+        for labels, outs in self._buf:
+            self.inner.update(labels, [NDArray(np.asarray(o))
+                                       for o in outs])
+        self._buf.clear()

@@ -359,3 +359,53 @@ def test_warmup_preserves_stateful_scheduler_decay():
     assert abs(lr_after_drop - 0.4) < 1e-9
     # calling again must NOT snap back to 0.8
     assert abs(w(5 + 12) - 0.4) < 1e-9
+
+
+def test_fit_fused_metric_matches_host_metric():
+    """fit()'s in-step Accuracy fold (zero extra dispatches) must produce
+    EXACTLY the metric the host-side Accuracy computes (VERDICT r3 item 6:
+    async fit metrics)."""
+    import jax
+    import numpy as np
+    from mxnet_tpu import models, metric as metric_mod
+    from mxnet_tpu.parallel import ShardedTrainer
+
+    b, nb = 32, 6
+    rng = np.random.RandomState(7)
+    X = rng.rand(b * nb, 1, 8, 8).astype(np.float32)
+    Y = rng.randint(0, 4, (b * nb,)).astype(np.float32)
+
+    def build():
+        mx.random.seed(5)
+        net = mx.symbol.SoftmaxOutput(
+            data=mx.symbol.FullyConnected(
+                data=mx.symbol.Flatten(mx.symbol.Variable("data")),
+                num_hidden=4, name="fc"),
+            name="softmax")
+        t = ShardedTrainer(net, optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.1})
+        t.bind(data_shapes={"data": (b, 1, 8, 8)},
+               label_shapes={"softmax_label": (b,)})
+        return t
+
+    # path A: fit() with the fused accuracy fold
+    t1 = build()
+    it = mx.io.NDArrayIter(X, Y, batch_size=b, shuffle=False)
+    captured = {}
+
+    def grab(param):
+        if param.nbatch == nb:
+            captured["nv"] = dict(param.eval_metric.get_name_value())
+    t1.fit(it, eval_metric="acc", num_epoch=1, batch_end_callback=grab)
+
+    # path B: manual loop, host-side Accuracy on fetched heads
+    t2 = build()
+    m = metric_mod.create("acc")
+    for i in range(nb):
+        batch = {"data": X[i * b:(i + 1) * b],
+                 "softmax_label": Y[i * b:(i + 1) * b]}
+        outs = t2.step(batch)
+        m.update([mx.nd.array(Y[i * b:(i + 1) * b])],
+                 [mx.nd.array(np.asarray(o)) for o in outs])
+    host = dict(m.get_name_value())
+    assert captured["nv"] == host, (captured["nv"], host)
