@@ -26,25 +26,26 @@ def _attention_fwd(ctx, params, q, k, v):
             and mesh.shape[axis] > 1):
         return ring_self_attention(q, k, v, mesh, seq_axis=axis,
                                    causal=causal)
-    # single shard: dense for short sequences, flash-style blockwise
-    # (never materializes [L, L] scores) past the threshold
+    # single shard: dense for short sequences, flash (fused Pallas
+    # kernel on TPU, jnp blockwise scan on cpu — never materializes the
+    # [L, L] scores) past the threshold
     block = params["block_size"]
     if block == 0:
         lk = k.shape[2]
-        # at 2048 the dense [L, L] f32 scores are already 16 MB per
-        # head-batch row saved for backward — 6L/batch-8 configs OOM a
-        # 16 GB chip, so the flash path takes over AT the threshold
-        if lk >= 2048:
-            # largest power-of-two block that divides L; lengths with no
-            # divisor >= 64 (blockwise requires divisibility) fall back
+        # at 1024+ the fused kernel beats dense outright (r4 bench:
+        # 257k tok/s @ seq 2048 vs dense 218k @ 1024 on the 6L d512 LM)
+        # and dense [L, L] f32 score residuals OOM 16 GB chips at 2048
+        if lk >= 1024:
+            # largest power-of-two block that divides L (shared policy
+            # with the kernel); lengths with no divisor >= 64 fall back
             # to dense WITH a warning — pad the sequence or pass
             # block_size explicitly to avoid the [L, L] score memory
-            block = next((b for b in (512, 256, 128, 64)
-                          if lk % b == 0), None)
+            from ..parallel.flash_attention import _pick_block
+            block = _pick_block(lk)
             if block is None:
                 import logging
                 logging.getLogger(__name__).warning(
-                    "attention seq len %d >= 2048 has no power-of-two "
+                    "attention seq len %d >= 1024 has no power-of-two "
                     "block divisor; using DENSE attention ([L, L] scores "
                     "materialize) — pad the sequence to a multiple of 64",
                     lk)
@@ -143,8 +144,9 @@ register_op(OpDef(
         "causal": OpParam("causal", "bool", default=False),
         "seq_axis": OpParam("seq_axis", "str", default="seq"),
         "block_size": OpParam("block_size", "int", default=0,
-                              doc="0 = auto (dense below 2048, flash-style "
-                                  "blockwise at/above)"),
+                              doc="0 = auto (dense below 1024; fused Pallas "
+                                  "flash kernel on TPU / blockwise scan on "
+                                  "cpu at/above)"),
     },
     infer_shape=_attention_shape,
     doc="Exact scaled-dot-product attention over [B, H, L, D]; "

@@ -40,6 +40,11 @@ def local_attention(q, k, v, *, causal=False, scale=None,
     materializes the full ``[L, Lk]`` score matrix.
     """
     if block_size is not None:
+        if q_offset == 0 and kv_offset == 0:
+            # fused Pallas kernel on accelerators, jnp scan on cpu
+            from .flash_attention import flash_attention
+            return flash_attention(q, k, v, causal=causal, scale=scale,
+                                   block_q=None, block_k=block_size)
         return blockwise_attention(q, k, v, block_size, causal=causal,
                                    scale=scale, q_offset=q_offset,
                                    kv_offset=kv_offset, neg_inf=neg_inf)

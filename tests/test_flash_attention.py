@@ -1,0 +1,96 @@
+"""Pallas flash-attention kernel: interpret-mode correctness on CPU.
+
+The fused kernel (``parallel/flash_attention.py``) replaces the jnp-scan
+blockwise path on accelerators (VERDICT r3 item 2); here the SAME kernel
+code runs under ``pallas_call(interpret=True)`` against the dense
+reference, including the custom-VJP backward kernels.  The real-chip
+lane (``test_tpu_real.py``) exercises the compiled Mosaic path.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mxnet_tpu.parallel.flash_attention import flash_attention
+from mxnet_tpu.parallel.ring_attention import local_attention
+
+
+def _qkv(b=1, h=2, l=256, d=64, seed=0, dtype=np.float32):
+    rng = np.random.RandomState(seed)
+    mk = lambda: jnp.asarray(rng.randn(b, h, l, d).astype(dtype) * 0.3)
+    return mk(), mk(), mk()
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_fwd_interpret_matches_dense(causal):
+    q, k, v = _qkv()
+    y = flash_attention(q, k, v, causal=causal, block_q=128, block_k=128,
+                        interpret=True)
+    ref = local_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_bwd_interpret_matches_dense(causal):
+    q, k, v = _qkv(seed=3)
+
+    def loss_flash(q, k, v):
+        y = flash_attention(q, k, v, causal=causal, block_q=128,
+                            block_k=128, interpret=True)
+        return jnp.sum(y * jnp.cos(y))
+
+    def loss_dense(q, k, v):
+        y = local_attention(q, k, v, causal=causal)
+        return jnp.sum(y * jnp.cos(y))
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_dense = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for gf, gd, name in zip(g_flash, g_dense, "qkv"):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gd),
+                                   rtol=2e-4, atol=2e-4,
+                                   err_msg=f"d{name}")
+
+
+def test_flash_uneven_blocks_interpret():
+    """block_q != block_k and multiple batch/head rows."""
+    q, k, v = _qkv(b=2, h=3, l=256, seed=5)
+    y = flash_attention(q, k, v, causal=True, block_q=64, block_k=128,
+                        interpret=True)
+    ref = local_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_flash_cpu_dispatch_runs_reference():
+    """Without interpret, the cpu branch of platform_dependent serves the
+    jnp-scan path — same numbers, no Mosaic involved."""
+    q, k, v = _qkv(seed=7)
+    y = flash_attention(q, k, v, causal=True)
+    ref = local_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_flash_fallback_unsupported_shape():
+    """Shapes with no valid block divisor fall back to the jnp path."""
+    q, k, v = _qkv(l=192, seed=9)  # 192 = 64*3: block 64 works
+    y = flash_attention(q, k, v, causal=False, interpret=False)
+    assert y.shape == q.shape
+    # l=100 has no >=64 divisor -> reference path (still correct)
+    q2, k2, v2 = _qkv(l=100, seed=11)
+    y2 = flash_attention(q2, k2, v2, causal=True)
+    ref2 = local_attention(q2, k2, v2, causal=True)
+    np.testing.assert_allclose(np.asarray(y2), np.asarray(ref2),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_flash_fallback_indivisible_length_is_dense():
+    """L with no >=64 power-of-two divisor must serve the DENSE reference
+    instead of crashing in blockwise (review finding r4)."""
+    q, k, v = _qkv(l=1000, seed=13)
+    y = flash_attention(q, k, v, causal=True)
+    ref = local_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
