@@ -229,18 +229,20 @@ def bench_lm(args):
          "softmax_label": rng.randint(0, vocab, (b, l)).astype(np.float32)})
         for _ in range(2)]
     per_step, dispatch, compile_s, flops = measure(trainer, feeds, args.steps)
-    from mxnet_tpu.parallel.flash_attention import _pick_block
+    from mxnet_tpu.parallel.flash_attention import (AUTO_SWITCH_LEN,
+                                                    _pick_block)
     # matches the op's auto-switch: only blockwise/flash-served lengths
     # need the analytic attention term (dense einsums ARE cost-counted)
-    if flops is not None and l >= 1024 and _pick_block(l) is not None:
+    if (flops is not None and l >= AUTO_SWITCH_LEN
+            and _pick_block(l) is not None):
         # blockwise/flash regime: XLA's cost model counts neither scan
         # bodies (documented in docs/perf.md) nor Pallas kernels, so add
-        # the attention train FLOPs analytically — per layer, fwd is
-        # QK^T + PV = 4*B*H*L^2*D flops (x0.5 causal), and the flash
-        # backward recomputes scores in both the dq and dk/dv kernels
-        # (7 block-matmuls vs the forward's 2), so train total = 4.5x fwd
-        heads = max(1, args.d_model // 64)
-        att_fwd = 4.0 * b * heads * l * l * (args.d_model // heads) * 0.5
+        # the attention train FLOPs analytically — fwd per layer is
+        # QK^T + PV = 4*B*L^2*d_model flops x0.5 causal = 2*B*L^2*d
+        # (head count cancels: H * (d/H) = d), and the flash backward
+        # recomputes scores in both the dq and dk/dv kernels (7
+        # block-matmuls vs the forward's 2), so train total = 4.5x fwd
+        att_fwd = 2.0 * b * l * l * args.d_model
         flops += args.num_layers * 4.5 * att_fwd
     tok_s = b * l / per_step
     prec = args.compute_dtype or args.precision

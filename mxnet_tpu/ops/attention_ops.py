@@ -35,12 +35,12 @@ def _attention_fwd(ctx, params, q, k, v):
         # at 1024+ the fused kernel beats dense outright (r4 bench:
         # 257k tok/s @ seq 2048 vs dense 218k @ 1024 on the 6L d512 LM)
         # and dense [L, L] f32 score residuals OOM 16 GB chips at 2048
-        if lk >= 1024:
+        from ..parallel.flash_attention import AUTO_SWITCH_LEN, _pick_block
+        if lk >= AUTO_SWITCH_LEN:
             # largest power-of-two block that divides L (shared policy
             # with the kernel); lengths with no divisor >= 64 fall back
             # to dense WITH a warning — pad the sequence or pass
             # block_size explicitly to avoid the [L, L] score memory
-            from ..parallel.flash_attention import _pick_block
             block = _pick_block(lk)
             if block is None:
                 import logging

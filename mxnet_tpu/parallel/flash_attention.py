@@ -30,6 +30,11 @@ import jax.numpy as jnp
 
 NEG_INF = -1e30
 
+# sequence length at/above which the Attention op auto-switches from
+# dense to the flash path (shared by ops/attention_ops.py and bench.py's
+# analytic-FLOPs accounting — keep ONE definition)
+AUTO_SWITCH_LEN = 1024
+
 
 def _pick_block(length: int, preferred: int = 512) -> Optional[int]:
     for b in (preferred, 512, 256, 128, 64):
@@ -357,6 +362,7 @@ def flash_attention(q, k, v, *, causal=False, scale=None,
     kernel_ok = (
         bq is not None and bk is not None
         and lq == lk                      # self-attention layout
+        and lq % bq == 0 and lk % bk == 0  # grid truncates otherwise
         and bq >= 64 and bk >= 64
         and d <= 256
         and q.dtype in (jnp.float32, jnp.bfloat16)

@@ -94,3 +94,14 @@ def test_flash_fallback_indivisible_length_is_dense():
     ref = local_attention(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_flash_nondividing_explicit_blocks_fall_back():
+    """Explicit blocks that do not divide L must take the safe reference
+    path (review finding r4: the kernel grid would silently truncate)."""
+    q, k, v = _qkv(l=320, seed=15)
+    y = flash_attention(q, k, v, causal=True, block_q=128, block_k=128,
+                        interpret=True)
+    ref = local_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)

