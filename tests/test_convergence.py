@@ -1,0 +1,286 @@
+"""Convergence regression gates: golden loss trajectories + plain-JAX twin.
+
+VERDICT r3 item 4: toy 100%-accuracy gates cannot catch a subtle
+BN-momentum / weight-decay / lr-schedule bug that costs accuracy at
+scale.  These tests train (a) a ResNet-8 on a hard synthetic image task
+and (b) a 2-layer transformer-LM on synthetic Markov text for hundreds
+of steps, and assert the loss trajectory matches a committed known-good
+recording (``tests/golden/*.json``) — the pattern of the reference's
+accuracy-threshold train tests (``tests/python/train/test_conv.py``)
+strengthened to the whole curve.
+
+The transformer trajectory is additionally cross-checked against a
+HAND-ROLLED plain-JAX twin (embedding -> [LN -> causal attention ->
+proj -> residual -> LN -> FFN -> residual] x2 -> LN -> lm_head -> CE,
+SGD-momentum updates) built from nothing but jnp — if the framework's
+op lowerings, loss-head backward scaling, or optimizer arithmetic
+drift, the twin diverges loudly.
+
+Regenerate goldens after an INTENDED change with:
+    MXNET_TPU_RECORD_GOLDEN=1 python -m pytest tests/test_convergence.py
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import models
+from mxnet_tpu.parallel import ShardedTrainer, make_mesh
+
+GOLDEN_DIR = os.path.join(os.path.dirname(__file__), "golden")
+RECORD = os.environ.get("MXNET_TPU_RECORD_GOLDEN", "0") == "1"
+
+STEPS = 300
+EVERY = 10
+
+
+def _check_or_record(name, losses):
+    path = os.path.join(GOLDEN_DIR, name)
+    if RECORD:
+        os.makedirs(GOLDEN_DIR, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump({"steps": STEPS, "every": EVERY,
+                       "losses": [round(float(x), 6) for x in losses]}, f,
+                      indent=1)
+        pytest.skip(f"recorded golden {name}")
+    if not os.path.exists(path):
+        pytest.fail(f"golden file {path} missing — run with "
+                    f"MXNET_TPU_RECORD_GOLDEN=1 to record")
+    with open(path) as f:
+        golden = json.load(f)
+    g = np.asarray(golden["losses"])
+    l = np.asarray(losses)
+    assert g.shape == l.shape, (g.shape, l.shape)
+    # pointwise trajectory match (tolerates fp scheduling noise, fails
+    # on real regressions: a 2x-too-strong weight decay or a broken BN
+    # momentum shifts the curve far beyond this band)
+    np.testing.assert_allclose(l, g, rtol=0.10, atol=0.05,
+                               err_msg=f"trajectory diverged from {name}")
+    # and the run must actually learn as much as the golden did
+    assert l[-1] < 0.6 * l[0] + 0.05, (l[0], l[-1])
+
+
+def _ce_from_probs(probs, labels):
+    p = np.asarray(probs)
+    idx = np.asarray(labels).astype(np.int64).reshape(-1)
+    return float(-np.mean(np.log(np.maximum(p[np.arange(len(idx)), idx],
+                                            1e-12))))
+
+
+# ---------------------------------------------------------------------------
+# (a) ResNet-8 on a hard synthetic image task
+# ---------------------------------------------------------------------------
+
+def _grating_images(n, size=24, classes=4, seed=0):
+    """Oriented sinusoidal gratings with random phase/frequency + noise:
+    class = orientation.  Random phase defeats linear models; conv
+    features solve it."""
+    rng = np.random.RandomState(seed)
+    ys = rng.randint(0, classes, n)
+    xs = np.zeros((n, 3, size, size), np.float32)
+    grid = np.arange(size, dtype=np.float32) / size
+    gx, gy = np.meshgrid(grid, grid, indexing="ij")
+    for i, c in enumerate(ys):
+        theta = np.pi * c / classes
+        freq = rng.uniform(2.0, 4.0)
+        phase = rng.uniform(0, 2 * np.pi)
+        img = np.sin(2 * np.pi * freq * (gx * np.cos(theta)
+                                         + gy * np.sin(theta)) + phase)
+        img = img + 0.7 * rng.randn(size, size)
+        xs[i] = img[None, :, :]
+    return xs.astype(np.float32), ys.astype(np.float32)
+
+
+def _init_args(sym, input_shapes, seed):
+    arg_shapes, _, _ = sym.infer_shape(**input_shapes)
+    rng = np.random.RandomState(seed)
+    out = {}
+    for n, s in zip(sym.list_arguments(), arg_shapes):
+        if n in input_shapes:
+            continue
+        if n.endswith("_bias") or n.endswith("_beta"):
+            out[n] = np.zeros(s, np.float32)
+        elif n.endswith("_gamma"):
+            out[n] = np.ones(s, np.float32)
+        else:
+            out[n] = (rng.uniform(-1, 1, s)
+                      * np.sqrt(3.0 / max(1, int(np.prod(s[1:]))))
+                      ).astype(np.float32)
+    return out
+
+
+def test_resnet8_loss_trajectory():
+    b, size, classes = 32, 24, 4
+    sym = models.get_symbol("resnet-28-small", num_classes=classes, n=1)
+    shapes = {"data": (b, 3, size, size), "softmax_label": (b,)}
+    args = _init_args(sym, shapes, seed=11)
+    X, Y = _grating_images(b * 32, size=size, classes=classes, seed=3)
+    t = ShardedTrainer(sym, optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.02,
+                                         "momentum": 0.9},
+                       mesh=make_mesh({"data": 1}, jax.devices()[:1]))
+    t.bind(data_shapes={"data": shapes["data"]},
+           label_shapes={"softmax_label": shapes["softmax_label"]},
+           arg_params=args)
+    losses = []
+    for step in range(STEPS):
+        k = step % 32
+        batch = {"data": X[k * b:(k + 1) * b],
+                 "softmax_label": Y[k * b:(k + 1) * b]}
+        out = t.step(batch)
+        if step % EVERY == 0:
+            losses.append(_ce_from_probs(out[0],
+                                         batch["softmax_label"]))
+    _check_or_record("convergence_resnet8.json", losses)
+
+
+# ---------------------------------------------------------------------------
+# (b) 2-layer transformer-LM on synthetic Markov text (+ plain-JAX twin)
+# ---------------------------------------------------------------------------
+
+V, D, H, L, B = 32, 64, 2, 32, 16
+NL = 2
+
+
+def _markov_text(n_seqs, seed=0):
+    """Token streams from a fixed sparse Markov chain — learnable
+    bigram structure, far from uniform."""
+    rng = np.random.RandomState(seed)
+    trans = rng.dirichlet(np.full(V, 0.12), size=V)
+    seqs = np.zeros((n_seqs, L + 1), np.int64)
+    for i in range(n_seqs):
+        s = rng.randint(V)
+        for p in range(L + 1):
+            seqs[i, p] = s
+            s = rng.choice(V, p=trans[s])
+    return seqs
+
+
+def _lm_setup(seed=21):
+    sym = models.get_symbol("transformer-lm", vocab_size=V, num_layers=NL,
+                            d_model=D, heads=H, batch_size=B, seq_len=L)
+    shapes = {"data": (B, L), "softmax_label": (B, L)}
+    args = _init_args(sym, shapes, seed=seed)
+    seqs = _markov_text(B * 8, seed=5)
+    return sym, shapes, args, seqs
+
+
+def _framework_lm_losses(sym, shapes, args, seqs):
+    t = ShardedTrainer(sym, optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.003,
+                                         "momentum": 0.9},
+                       mesh=make_mesh({"data": 1}, jax.devices()[:1]))
+    t.bind(data_shapes={"data": shapes["data"]},
+           label_shapes={"softmax_label": shapes["softmax_label"]},
+           arg_params=args)
+    losses = []
+    nb = len(seqs) // B
+    for step in range(STEPS):
+        k = step % nb
+        chunk = seqs[k * B:(k + 1) * B]
+        batch = {"data": chunk[:, :L].astype(np.float32),
+                 "softmax_label": chunk[:, 1:].astype(np.float32)}
+        out = t.step(batch)
+        if step % EVERY == 0:
+            losses.append(_ce_from_probs(out[0],
+                                         batch["softmax_label"]))
+    return losses
+
+
+def _twin_lm_losses(args, seqs):
+    """Plain-JAX reimplementation of the same model + SGD training —
+    shares NOTHING with mxnet_tpu but the initial params and data."""
+    p0 = {k: jnp.asarray(v) for k, v in args.items()}
+    hd = D // H
+
+    def layernorm(x, g, b2):
+        m = jnp.mean(x, axis=-1, keepdims=True)
+        v = jnp.var(x, axis=-1, keepdims=True)
+        return (x - m) * jax.lax.rsqrt(v + 1e-5) * g + b2
+
+    def linear(x, p, name):
+        return x @ p[f"{name}_weight"].T + p[f"{name}_bias"]
+
+    def forward(p, ids):
+        x = p["embed_weight"][ids]                       # [B, L, D]
+        for i in range(NL):
+            nm = f"layer{i}"
+            h = layernorm(x, p[f"{nm}_ln1_gamma"], p[f"{nm}_ln1_beta"])
+            q = linear(h, p, f"{nm}_q").reshape(B, L, H, hd)
+            k = linear(h, p, f"{nm}_k").reshape(B, L, H, hd)
+            v = linear(h, p, f"{nm}_v").reshape(B, L, H, hd)
+            q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+            scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(hd)
+            mask = jnp.arange(L)[:, None] >= jnp.arange(L)[None, :]
+            scores = jnp.where(mask[None, None], scores, -1e30)
+            att = jax.nn.softmax(scores, axis=-1)
+            o = jnp.einsum("bhqk,bhkd->bhqd", att, v)
+            o = o.transpose(0, 2, 1, 3).reshape(B, L, D)
+            x = x + linear(o, p, f"{nm}_proj")
+            h = layernorm(x, p[f"{nm}_ln2_gamma"], p[f"{nm}_ln2_beta"])
+            h = jax.nn.relu(linear(h, p, f"{nm}_ffn1"))
+            x = x + linear(h, p, f"{nm}_ffn2")
+        x = layernorm(x, p["final_ln_gamma"], p["final_ln_beta"])
+        return linear(x.reshape(B * L, D), p, "lm_head")  # logits
+
+    def loss_fn(p, ids, labels):
+        logits = forward(p, ids)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -logp[jnp.arange(B * L), labels]
+        # framework loss-head scaling: SoftmaxOutput backward is
+        # (prob - onehot) and the trainer rescales grads by 1/B (the
+        # batch dim), i.e. the objective is sum-over-tokens CE / B
+        return jnp.sum(nll) / B, jnp.mean(nll)
+
+    grad_fn = jax.jit(jax.value_and_grad(loss_fn, has_aux=True))
+
+    @jax.jit
+    def sgd(p, mom, g, lr, momentum):
+        new_p, new_m = {}, {}
+        for k in p:
+            m2 = momentum * mom[k] - lr * g[k]
+            new_p[k] = p[k] + m2
+            new_m[k] = m2
+        return new_p, new_m
+
+    p = dict(p0)
+    mom = {k: jnp.zeros_like(v) for k, v in p.items()}
+    losses = []
+    nb = len(seqs) // B
+    for step in range(STEPS):
+        kk = step % nb
+        chunk = seqs[kk * B:(kk + 1) * B]
+        ids = jnp.asarray(chunk[:, :L].astype(np.int32))
+        labels = jnp.asarray(chunk[:, 1:].reshape(-1).astype(np.int32))
+        (l, mean_nll), g = grad_fn(p, ids, labels)
+        if step % EVERY == 0:
+            losses.append(float(mean_nll))
+        p, mom = sgd(p, mom, g, 0.003, 0.9)
+    return losses
+
+
+def test_transformer2l_loss_trajectory_and_twin():
+    sym, shapes, args, seqs = _lm_setup()
+    fw = _framework_lm_losses(sym, shapes, args, seqs)
+    _check_or_record("convergence_transformer2l.json", fw)
+
+
+def test_transformer2l_matches_plain_jax_twin():
+    sym, shapes, args, seqs = _lm_setup()
+    fw = np.asarray(_framework_lm_losses(sym, shapes, args, seqs))
+    tw = np.asarray(_twin_lm_losses(args, seqs))
+    # identical math, independent implementations.  Early/mid trajectory
+    # must agree tightly — any semantic difference (loss-head scaling,
+    # LN eps, mask convention, optimizer arithmetic) shows up at step 0
+    # as a large gap.  Late training is chaotic: fp scheduling noise
+    # compounds through 300 momentum updates, so only a loose band is
+    # meaningful there.
+    np.testing.assert_allclose(fw[:15], tw[:15], rtol=5e-3, atol=5e-3,
+                               err_msg="framework diverged from the "
+                               "hand-rolled plain-JAX twin")
+    np.testing.assert_allclose(fw[15:], tw[15:], rtol=0.25, atol=0.05)
