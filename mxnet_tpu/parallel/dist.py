@@ -30,18 +30,27 @@ __all__ = ["init_distributed", "is_initialized", "process_index",
            "process_count"]
 
 _initialized = False
+_watchdog = None
 
 
 def init_distributed(coordinator_address: Optional[str] = None,
                      num_processes: Optional[int] = None,
-                     process_id: Optional[int] = None) -> None:
+                     process_id: Optional[int] = None,
+                     watchdog: Optional[bool] = None) -> None:
     """Initialize ``jax.distributed`` from args or environment.
 
     Env fallbacks: ``MXTPU_COORDINATOR`` (host:port), ``MXTPU_NUM_PROC``,
     ``MXTPU_PROC_ID``; on Cloud TPU all three may be omitted and the TPU
     metadata service provides them.
+
+    ``watchdog=True`` (or env ``MXTPU_WATCHDOG=host:port``) starts the
+    collective-tier heartbeat failure detector
+    (:class:`~mxnet_tpu.parallel.watchdog.Watchdog`): a lost peer is
+    declared dead after missed heartbeats and every surviving process
+    aborts instead of hanging in its next collective.  The watchdog
+    address defaults to the coordinator host with port+1.
     """
-    global _initialized
+    global _initialized, _watchdog
     if _initialized:
         return
     import jax
@@ -57,6 +66,21 @@ def init_distributed(coordinator_address: Optional[str] = None,
     except Exception as e:  # pragma: no cover - env-specific
         raise MXNetError(f"jax.distributed initialization failed: {e}") from e
     _initialized = True
+
+    wd_env = os.environ.get("MXTPU_WATCHDOG")
+    if watchdog or (watchdog is None and wd_env):
+        from .watchdog import Watchdog
+        if wd_env:
+            host, port = wd_env.rsplit(":", 1)
+        elif coordinator_address:
+            host, port_s = coordinator_address.rsplit(":", 1)
+            port = int(port_s) + 1
+        else:  # pragma: no cover - env-specific
+            raise MXNetError("watchdog requires MXTPU_WATCHDOG or a "
+                             "coordinator address")
+        _watchdog = Watchdog(rank=jax.process_index(),
+                             world=jax.process_count(),
+                             monitor_addr=(host, int(port))).start()
 
 
 def is_initialized() -> bool:

@@ -1,0 +1,246 @@
+"""Failure detection for the collective tier (heartbeat watchdog).
+
+The PS tier detects worker death in its scheduler and aborts barrier
+waiters (``dist_kvstore.py``).  The collective tier
+(``jax.distributed`` + XLA collectives) has no such story upstream —
+a lost process leaves every peer's next all-reduce hung until opaque
+runtime timeouts fire.  The reference had nothing either (SURVEY §5);
+this closes the gap the same way production NCCL watchdogs do: a tiny
+side-channel heartbeat mesh, and a hard process abort when a peer is
+declared dead (a hung collective cannot be interrupted from Python —
+exiting the process is the only reliable unblock).
+
+Protocol (one TCP connection per peer to the rank-0 monitor):
+
+* every process connects to ``monitor_addr`` and sends its rank, then a
+  beat byte every ``interval`` seconds;
+* the monitor thread marks a peer dead after ``timeout`` seconds of
+  silence (or connection loss), then broadcasts ``ABORT <rank>`` to all
+  surviving peers and triggers its own ``on_failure``;
+* each peer's listener thread receives the abort and calls
+  ``on_failure(dead_rank)`` — default: log loudly, then ``os._exit(70)``
+  after a short grace so cleanup hooks (launchers' pkill sweeps, job
+  managers) observe a crashed process instead of a hang.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import socket
+import struct
+import threading
+import time
+from typing import Callable, Optional, Tuple
+
+__all__ = ["Watchdog"]
+
+log = logging.getLogger(__name__)
+
+_MAGIC = b"MXWD1"
+
+
+def _default_on_failure(dead_rank: int) -> None:
+    log.error("watchdog: peer rank %d declared DEAD — aborting this "
+              "process to unblock hung collectives", dead_rank)
+    time.sleep(0.5)  # let the log line flush / tests observe side files
+    os._exit(70)
+
+
+class Watchdog:
+    """Heartbeat failure detector over a rank-0 monitor.
+
+    Parameters
+    ----------
+    rank, world : this process's rank and the process count.
+    monitor_addr : (host, port) of rank 0's monitor socket.
+    interval : seconds between beats.
+    timeout : silence after which a peer is declared dead
+        (default ``5 * interval``).
+    on_failure : callback ``(dead_rank) -> None``; default logs and
+        hard-exits the process (the only reliable way out of a hung
+        XLA collective).
+    """
+
+    def __init__(self, rank: int, world: int,
+                 monitor_addr: Tuple[str, int],
+                 interval: float = 2.0,
+                 timeout: Optional[float] = None,
+                 on_failure: Optional[Callable[[int], None]] = None):
+        self.rank = int(rank)
+        self.world = int(world)
+        self.monitor_addr = (monitor_addr[0], int(monitor_addr[1]))
+        self.interval = float(interval)
+        self.timeout = float(timeout if timeout is not None
+                             else 5 * interval)
+        self.on_failure = on_failure or _default_on_failure
+        self._stop = threading.Event()
+        self._threads = []
+        self._server = None
+        self._sock = None
+
+    # ------------------------------------------------------------------
+
+    def start(self) -> "Watchdog":
+        if self.rank == 0:
+            self._start_monitor()
+        self._start_peer()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        for s in (self._sock, self._server):
+            if s is not None:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+        for t in self._threads:
+            t.join(timeout=2.0)
+
+    # ------------------------------------------------------------------
+    # rank-0 monitor
+    # ------------------------------------------------------------------
+
+    def _start_monitor(self) -> None:
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind(self.monitor_addr)
+        srv.listen(self.world + 4)
+        srv.settimeout(0.5)
+        self._server = srv
+        self._last_seen = {}
+        self._conns = {}
+        self._mon_lock = threading.Lock()
+
+        def accept_loop():
+            while not self._stop.is_set():
+                try:
+                    conn, _ = srv.accept()
+                except socket.timeout:
+                    continue
+                except OSError:
+                    return
+                conn.settimeout(self.timeout)
+                hdr = self._recv_exact(conn, len(_MAGIC) + 4)
+                if hdr is None or hdr[:len(_MAGIC)] != _MAGIC:
+                    conn.close()
+                    continue
+                (peer,) = struct.unpack("<i", hdr[len(_MAGIC):])
+                with self._mon_lock:
+                    self._conns[peer] = conn
+                    self._last_seen[peer] = time.monotonic()
+                t = threading.Thread(target=beat_loop, args=(peer, conn),
+                                     daemon=True)
+                t.start()
+
+        def beat_loop(peer, conn):
+            while not self._stop.is_set():
+                try:
+                    b = conn.recv(1)
+                except (socket.timeout, OSError):
+                    b = b""
+                if self._stop.is_set():
+                    return
+                if not b:
+                    self._declare_dead(peer)
+                    return
+                with self._mon_lock:
+                    self._last_seen[peer] = time.monotonic()
+
+        def stale_loop():
+            while not self._stop.is_set():
+                time.sleep(self.interval)
+                now = time.monotonic()
+                with self._mon_lock:
+                    stale = [p for p, ts in self._last_seen.items()
+                             if now - ts > self.timeout]
+                for p in stale:
+                    self._declare_dead(p)
+                    return
+
+        for fn in (accept_loop, stale_loop):
+            t = threading.Thread(target=fn, daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _declare_dead(self, peer: int) -> None:
+        if self._stop.is_set():
+            return
+        self._stop.set()
+        log.error("watchdog monitor: rank %d missed heartbeats — "
+                  "broadcasting abort", peer)
+        msg = _MAGIC + b"A" + struct.pack("<i", peer)
+        with self._mon_lock:
+            conns = dict(self._conns)
+        for r, c in conns.items():
+            if r == peer:
+                continue
+            try:
+                c.sendall(msg)
+            except OSError:
+                pass
+        self.on_failure(peer)
+
+    # ------------------------------------------------------------------
+    # peer side (all ranks, incl. 0's own connection to itself)
+    # ------------------------------------------------------------------
+
+    def _start_peer(self) -> None:
+        deadline = time.monotonic() + max(10.0, self.timeout)
+        sock = None
+        while time.monotonic() < deadline:
+            try:
+                sock = socket.create_connection(self.monitor_addr,
+                                                timeout=2.0)
+                break
+            except OSError:
+                time.sleep(0.2)
+        if sock is None:
+            raise OSError(f"watchdog: cannot reach monitor at "
+                          f"{self.monitor_addr}")
+        sock.sendall(_MAGIC + struct.pack("<i", self.rank))
+        sock.settimeout(self.interval)
+        self._sock = sock
+
+        def peer_loop():
+            last_beat = 0.0
+            while not self._stop.is_set():
+                now = time.monotonic()
+                if now - last_beat >= self.interval:
+                    try:
+                        sock.sendall(b".")
+                    except OSError:
+                        return
+                    last_beat = now
+                try:
+                    data = self._recv_exact(sock, len(_MAGIC) + 5)
+                except OSError:
+                    return
+                if data is None:
+                    continue
+                if (data[:len(_MAGIC)] == _MAGIC
+                        and data[len(_MAGIC):len(_MAGIC) + 1] == b"A"):
+                    (dead,) = struct.unpack("<i", data[len(_MAGIC) + 1:])
+                    if not self._stop.is_set():
+                        self._stop.set()
+                        self.on_failure(dead)
+                    return
+
+        t = threading.Thread(target=peer_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    @staticmethod
+    def _recv_exact(conn, n):
+        buf = b""
+        while len(buf) < n:
+            try:
+                chunk = conn.recv(n - len(buf))
+            except socket.timeout:
+                if buf:
+                    continue
+                return None
+            if not chunk:
+                return None if not buf else None
+            buf += chunk
+        return buf

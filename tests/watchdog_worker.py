@@ -1,0 +1,42 @@
+"""Worker for the collective-tier watchdog test.
+
+argv: rank world port out_dir mode
+mode 'die'  -> exit silently after a few beats (the failure under test)
+mode 'work' -> run until the watchdog aborts us (on_failure writes a
+               marker file, then exits 0 so the test can assert cleanly)
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mxnet_tpu.parallel.watchdog import Watchdog  # noqa: E402
+
+
+def main():
+    rank, world, port = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+    out_dir, mode = sys.argv[4], sys.argv[5]
+
+    def on_failure(dead_rank):
+        with open(os.path.join(out_dir, f"abort_{rank}.txt"), "w") as f:
+            f.write(str(dead_rank))
+        os._exit(0)
+
+    wd = Watchdog(rank=rank, world=world, monitor_addr=("127.0.0.1", port),
+                  interval=0.3, timeout=1.2, on_failure=on_failure)
+    wd.start()
+    if mode == "die":
+        time.sleep(1.0)
+        os._exit(1)  # silent death, no goodbye
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        time.sleep(0.2)
+    # watchdog failed to fire
+    with open(os.path.join(out_dir, f"timeout_{rank}.txt"), "w") as f:
+        f.write("no abort")
+    sys.exit(2)
+
+
+if __name__ == "__main__":
+    main()
