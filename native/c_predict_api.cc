@@ -1,0 +1,317 @@
+// C predict API implementation: embeds CPython and serves .mxtpu
+// artifacts with nothing but jax (see c_predict_api.h for the contract;
+// reference parity surface: c_predict_api.h:40-207 redesigned around
+// the StableHLO artifact instead of a framework graph executor).
+#include "c_predict_api.h"
+
+#include <Python.h>
+
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+thread_local std::string g_last_error;
+
+void set_error(const std::string &msg) { g_last_error = msg; }
+
+void set_py_error(const char *where) {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  std::string msg = where;
+  if (value != nullptr) {
+    PyObject *s = PyObject_Str(value);
+    if (s != nullptr) {
+      const char *u = PyUnicode_AsUTF8(s);
+      if (u != nullptr) {
+        msg += ": ";
+        msg += u;
+      } else {
+        PyErr_Clear();  // un-encodable message; keep the location
+      }
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  set_error(msg);
+}
+
+// Serving code executed in the embedded interpreter.  Imports ONLY
+// numpy + jax; mirrors predictor.ExportedPredictor (V1/V2 artifacts).
+const char *kServePy = R"PY(
+import json, struct
+import numpy as np
+import jax
+from jax import export as _jexport
+
+class _Served:
+    def __init__(self, path):
+        with open(path, 'rb') as f:
+            magic = f.read(9)
+            if magic not in (b'MXTPUEXP1', b'MXTPUEXP2'):
+                raise ValueError(f'{path}: not an exported model')
+            (hlen,) = struct.unpack('<i', f.read(4))
+            meta = json.loads(f.read(hlen).decode())
+            self.exp = _jexport.deserialize(f.read())
+        ents = [(e[0], e[1], e[2] if len(e) > 2 else 'float32')
+                for e in meta['inputs']]
+        self.names = [n for n, _, _ in ents]
+        self.shapes = {n: tuple(s) for n, s, _ in ents}
+        self.dtypes = {n: d for n, _, d in ents}
+        self.inputs = {}
+        self.outputs = []
+
+    def set_input(self, name, buf):
+        if name not in self.shapes:
+            raise KeyError(f'unknown input {name!r}; have {self.names}')
+        arr = np.frombuffer(buf, dtype=np.float32)
+        want = int(np.prod(self.shapes[name])) if self.shapes[name] else 1
+        if arr.size != want:
+            raise ValueError(f'input {name!r}: got {arr.size} elements, '
+                             f'expected {want}')
+        self.inputs[name] = arr.reshape(self.shapes[name]).astype(
+            self.dtypes[name])
+
+    def forward(self):
+        missing = [n for n in self.names if n not in self.inputs]
+        if missing:
+            raise ValueError(f'inputs not set: {missing}')
+        outs = self.exp.call(*[self.inputs[n] for n in self.names])
+        self.outputs = [np.ascontiguousarray(np.asarray(o),
+                                             dtype=np.float32)
+                        for o in outs]
+
+    def output_bytes(self, i):
+        return self.outputs[i].tobytes()
+
+    def output_shape(self, i):
+        return list(self.outputs[i].shape)
+)PY";
+
+std::once_flag g_py_once;
+PyObject *g_module_dict = nullptr;  // dict holding _Served
+
+bool ensure_python() {
+  bool ok = true;
+  std::call_once(g_py_once, [&]() {
+    bool we_initialized = false;
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+      we_initialized = true;
+    }
+    PyGILState_STATE gil = PyGILState_Ensure();
+    PyObject *mod = PyImport_AddModule("__mxtpu_serve__");
+    PyObject *dict = PyModule_GetDict(mod);
+    // builtins must be reachable for exec of the serving code
+    PyDict_SetItemString(dict, "__builtins__", PyEval_GetBuiltins());
+    PyObject *res = PyRun_String(kServePy, Py_file_input, dict, dict);
+    if (res == nullptr) {
+      set_py_error("loading serving code (is jax importable? set "
+                   "PYTHONPATH to the serving environment)");
+      ok = false;
+    } else {
+      Py_DECREF(res);
+      g_module_dict = dict;
+      Py_INCREF(g_module_dict);
+    }
+    PyGILState_Release(gil);
+    if (ok && we_initialized) {
+      // release the GIL acquired implicitly by OUR Py_Initialize on
+      // this thread so later PyGILState_Ensure calls from any thread
+      // work.  When the HOST process owns the runtime (ctypes
+      // consumers), its GIL state is none of our business.
+      PyEval_SaveThread();
+    }
+  });
+  return ok && g_module_dict != nullptr;
+}
+
+struct Handle {
+  PyObject *obj = nullptr;  // _Served instance
+  std::vector<std::string> input_names;
+  std::vector<std::vector<int64_t>> input_shapes;
+  std::vector<std::vector<int64_t>> output_shapes;
+  int n_outputs = -1;
+};
+
+class Gil {
+ public:
+  Gil() : state_(PyGILState_Ensure()) {}
+  ~Gil() { PyGILState_Release(state_); }
+
+ private:
+  PyGILState_STATE state_;
+};
+
+bool fill_shape_vec(PyObject *seq, std::vector<int64_t> *out) {
+  PyObject *fast = PySequence_Fast(seq, "shape not a sequence");
+  if (fast == nullptr) return false;
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
+  out->clear();
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    out->push_back(PyLong_AsLongLong(PySequence_Fast_GET_ITEM(fast, i)));
+  }
+  Py_DECREF(fast);
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+const char *MXTPUGetLastError(void) { return g_last_error.c_str(); }
+
+int MXTPUPredCreate(const char *artifact_path, MXTPUPredictorHandle *out) {
+  if (!ensure_python()) return -1;
+  Gil gil;
+  PyObject *cls = PyDict_GetItemString(g_module_dict, "_Served");
+  PyObject *obj = PyObject_CallFunction(cls, "s", artifact_path);
+  if (obj == nullptr) {
+    set_py_error("MXTPUPredCreate");
+    return -1;
+  }
+  auto *h = new Handle;
+  h->obj = obj;
+  // cache input metadata for the info getters
+  PyObject *names = PyObject_GetAttrString(obj, "names");
+  PyObject *shapes = PyObject_GetAttrString(obj, "shapes");
+  Py_ssize_t n = PyList_Size(names);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *nm = PyList_GetItem(names, i);
+    const char *u = PyUnicode_AsUTF8(nm);
+    if (u == nullptr) {
+      PyErr_Clear();
+      u = "<unrepresentable>";
+    }
+    h->input_names.emplace_back(u);
+    PyObject *shp = PyDict_GetItem(shapes, nm);
+    std::vector<int64_t> dims;
+    fill_shape_vec(shp, &dims);
+    h->input_shapes.push_back(std::move(dims));
+  }
+  Py_DECREF(names);
+  Py_DECREF(shapes);
+  *out = h;
+  return 0;
+}
+
+int MXTPUPredGetInputCount(MXTPUPredictorHandle hv, int *out) {
+  *out = static_cast<int>(static_cast<Handle *>(hv)->input_names.size());
+  return 0;
+}
+
+int MXTPUPredGetInputInfo(MXTPUPredictorHandle hv, int index,
+                          const char **name, const int64_t **shape,
+                          int *ndim) {
+  auto *h = static_cast<Handle *>(hv);
+  if (index < 0 || index >= static_cast<int>(h->input_names.size())) {
+    set_error("input index out of range");
+    return -1;
+  }
+  *name = h->input_names[index].c_str();
+  *shape = h->input_shapes[index].data();
+  *ndim = static_cast<int>(h->input_shapes[index].size());
+  return 0;
+}
+
+int MXTPUPredSetInput(MXTPUPredictorHandle hv, const char *name,
+                      const float *data, size_t size) {
+  auto *h = static_cast<Handle *>(hv);
+  Gil gil;
+  PyObject *buf = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char *>(data),
+      static_cast<Py_ssize_t>(size * sizeof(float)));
+  PyObject *res = PyObject_CallMethod(h->obj, "set_input", "sO", name, buf);
+  Py_DECREF(buf);
+  if (res == nullptr) {
+    set_py_error("MXTPUPredSetInput");
+    return -1;
+  }
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTPUPredForward(MXTPUPredictorHandle hv) {
+  auto *h = static_cast<Handle *>(hv);
+  Gil gil;
+  PyObject *res = PyObject_CallMethod(h->obj, "forward", nullptr);
+  if (res == nullptr) {
+    set_py_error("MXTPUPredForward");
+    return -1;
+  }
+  Py_DECREF(res);
+  // refresh output shape cache
+  PyObject *outs = PyObject_GetAttrString(h->obj, "outputs");
+  h->n_outputs = static_cast<int>(PyList_Size(outs));
+  Py_DECREF(outs);
+  h->output_shapes.assign(h->n_outputs, {});
+  for (int i = 0; i < h->n_outputs; ++i) {
+    PyObject *shp = PyObject_CallMethod(h->obj, "output_shape", "i", i);
+    if (shp == nullptr || !fill_shape_vec(shp, &h->output_shapes[i])) {
+      Py_XDECREF(shp);
+      set_py_error("MXTPUPredForward (shapes)");
+      return -1;
+    }
+    Py_DECREF(shp);
+  }
+  return 0;
+}
+
+int MXTPUPredGetOutputCount(MXTPUPredictorHandle hv, int *out) {
+  auto *h = static_cast<Handle *>(hv);
+  if (h->n_outputs < 0) {
+    set_error("call MXTPUPredForward first");
+    return -1;
+  }
+  *out = h->n_outputs;
+  return 0;
+}
+
+int MXTPUPredGetOutputShape(MXTPUPredictorHandle hv, int index,
+                            const int64_t **shape, int *ndim) {
+  auto *h = static_cast<Handle *>(hv);
+  if (index < 0 || index >= h->n_outputs) {
+    set_error("output index out of range (forward not run?)");
+    return -1;
+  }
+  *shape = h->output_shapes[index].data();
+  *ndim = static_cast<int>(h->output_shapes[index].size());
+  return 0;
+}
+
+int MXTPUPredGetOutput(MXTPUPredictorHandle hv, int index, float *out,
+                       size_t size) {
+  auto *h = static_cast<Handle *>(hv);
+  Gil gil;
+  PyObject *bytes = PyObject_CallMethod(h->obj, "output_bytes", "i", index);
+  if (bytes == nullptr) {
+    set_py_error("MXTPUPredGetOutput");
+    return -1;
+  }
+  Py_ssize_t blen = PyBytes_Size(bytes);
+  if (static_cast<size_t>(blen) != size * sizeof(float)) {
+    Py_DECREF(bytes);
+    set_error("output size mismatch: have " + std::to_string(blen / 4) +
+              " elements, caller asked for " + std::to_string(size));
+    return -1;
+  }
+  std::memcpy(out, PyBytes_AsString(bytes), blen);
+  Py_DECREF(bytes);
+  return 0;
+}
+
+int MXTPUPredFree(MXTPUPredictorHandle hv) {
+  auto *h = static_cast<Handle *>(hv);
+  {
+    Gil gil;
+    Py_XDECREF(h->obj);
+  }
+  delete h;
+  return 0;
+}
+
+}  // extern "C"

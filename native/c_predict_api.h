@@ -1,0 +1,66 @@
+/*
+ * C predict API over exported mxnet_tpu artifacts (.mxtpu).
+ *
+ * Parity surface for the reference's c_predict_api.h:40-207
+ * (MXPredCreate / MXPredSetInput / MXPredForward / MXPredGetOutput /
+ * MXPredFree + error string), redesigned for the TPU-native deploy
+ * story: instead of a symbol-JSON + NDArray blob re-executed by a
+ * framework runtime, the artifact is ONE serialized StableHLO program
+ * (predictor.py:export_model) and this shim serves it from any C/C++
+ * host process.  All tensors cross the ABI as float32, exactly like
+ * the reference's mx_float interface; integer-typed inputs (token ids)
+ * are cast inside according to the dtype recorded in the artifact.
+ *
+ * Build: `make -C native c_predict` produces libmxtpu_predict.so.
+ * Runtime requirement: a Python with jax importable (set PYTHONPATH to
+ * the serving virtualenv's site-packages); nothing from mxnet_tpu is
+ * imported at serve time.
+ *
+ * Every function returns 0 on success, -1 on failure; call
+ * MXTPUGetLastError() for the message (thread-local).
+ */
+#ifndef MXTPU_C_PREDICT_API_H_
+#define MXTPU_C_PREDICT_API_H_
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef void *MXTPUPredictorHandle;
+
+/* Load an exported artifact.  Initializes the embedded Python runtime
+ * on first use. */
+int MXTPUPredCreate(const char *artifact_path, MXTPUPredictorHandle *out);
+
+int MXTPUPredGetInputCount(MXTPUPredictorHandle h, int *out);
+/* name/shape pointers stay valid until MXTPUPredFree(h). */
+int MXTPUPredGetInputInfo(MXTPUPredictorHandle h, int index,
+                          const char **name, const int64_t **shape,
+                          int *ndim);
+
+/* Copy `size` floats in as input `name` (row-major, full tensor). */
+int MXTPUPredSetInput(MXTPUPredictorHandle h, const char *name,
+                      const float *data, size_t size);
+
+/* Execute the program on the inputs set so far. */
+int MXTPUPredForward(MXTPUPredictorHandle h);
+
+int MXTPUPredGetOutputCount(MXTPUPredictorHandle h, int *out);
+int MXTPUPredGetOutputShape(MXTPUPredictorHandle h, int index,
+                            const int64_t **shape, int *ndim);
+/* Copy output `index` into `out` (`size` = element count). */
+int MXTPUPredGetOutput(MXTPUPredictorHandle h, int index, float *out,
+                       size_t size);
+
+int MXTPUPredFree(MXTPUPredictorHandle h);
+
+const char *MXTPUGetLastError(void);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* MXTPU_C_PREDICT_API_H_ */

@@ -1,0 +1,72 @@
+/* Smoke driver for the C predict API: loads an artifact, feeds one
+ * float32 input tensor from a file, writes every output tensor back.
+ * Usage: test_c_predict model.mxtpu input.bin output.bin
+ * Pure C — proves the ABI needs no C++ or Python on the caller side. */
+#include <stdio.h>
+#include <stdlib.h>
+
+#include "c_predict_api.h"
+
+static void die(const char *what) {
+  fprintf(stderr, "%s: %s\n", what, MXTPUGetLastError());
+  exit(1);
+}
+
+int main(int argc, char **argv) {
+  if (argc != 4) {
+    fprintf(stderr, "usage: %s model.mxtpu in.bin out.bin\n", argv[0]);
+    return 2;
+  }
+  MXTPUPredictorHandle h;
+  if (MXTPUPredCreate(argv[1], &h) != 0) die("create");
+
+  int n_in;
+  MXTPUPredGetInputCount(h, &n_in);
+  if (n_in != 1) {
+    fprintf(stderr, "expected 1 input, got %d\n", n_in);
+    return 2;
+  }
+  const char *name;
+  const int64_t *shape;
+  int ndim;
+  if (MXTPUPredGetInputInfo(h, 0, &name, &shape, &ndim) != 0)
+    die("input info");
+  size_t need = 1;
+  for (int i = 0; i < ndim; ++i) need *= (size_t)shape[i];
+  printf("input %s ndim=%d elems=%zu\n", name, ndim, need);
+
+  float *buf = (float *)malloc(need * sizeof(float));
+  FILE *f = fopen(argv[2], "rb");
+  if (!f || fread(buf, sizeof(float), need, f) != need) {
+    fprintf(stderr, "short read on %s\n", argv[2]);
+    return 2;
+  }
+  fclose(f);
+  if (MXTPUPredSetInput(h, name, buf, need) != 0) die("set input");
+  if (MXTPUPredForward(h) != 0) die("forward");
+
+  int n_out;
+  if (MXTPUPredGetOutputCount(h, &n_out) != 0) die("output count");
+  FILE *g = fopen(argv[3], "wb");
+  if (!g) {
+    fprintf(stderr, "cannot open %s for writing\n", argv[3]);
+    return 2;
+  }
+  for (int i = 0; i < n_out; ++i) {
+    const int64_t *oshape;
+    int ondim;
+    if (MXTPUPredGetOutputShape(h, i, &oshape, &ondim) != 0)
+      die("output shape");
+    size_t oelems = 1;
+    for (int d = 0; d < ondim; ++d) oelems *= (size_t)oshape[d];
+    float *out = (float *)malloc(oelems * sizeof(float));
+    if (MXTPUPredGetOutput(h, i, out, oelems) != 0) die("get output");
+    fwrite(out, sizeof(float), oelems, g);
+    free(out);
+  }
+  fclose(g);
+  free(buf);
+  MXTPUPredFree(h);
+  printf("served %d outputs ok\n", n_out);
+  return 0;
+}

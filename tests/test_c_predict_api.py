@@ -1,0 +1,86 @@
+"""C-ABI deploy lane: build libmxtpu_predict.so + a pure-C driver, serve
+an exported artifact from C, compare against the in-Python predictor.
+
+VERDICT r3 item 10 (bindings row): the reference's other-language story
+was the C predict API that R/Scala/Matlab glue wrapped
+(c_predict_api.h:40-207); the TPU-native equivalent is this C ABI over
+the StableHLO artifact — any language with a C FFI gets the deploy
+surface from one header + one shared library.
+"""
+import os
+import subprocess
+import sys
+import sysconfig
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NATIVE = os.path.join(REPO, "native")
+
+
+def _build():
+    r = subprocess.run(["make", "-C", NATIVE, "c_predict",
+                        f"PYTHON={sys.executable}"],
+                       capture_output=True, text=True, timeout=300)
+    if r.returncode != 0:
+        pytest.skip(f"cannot build predict shim: {r.stderr[-400:]}")
+    lib = os.path.join(NATIVE, "libmxtpu_predict.so")
+    exe = os.path.join(NATIVE, "test_c_predict")
+    r = subprocess.run(
+        ["gcc", "-O2", "-o", exe,
+         os.path.join(NATIVE, "test_c_predict.c"),
+         "-I", NATIVE, "-L", NATIVE, "-lmxtpu_predict",
+         f"-Wl,-rpath,{NATIVE}"],
+        capture_output=True, text=True, timeout=120)
+    if r.returncode != 0:
+        pytest.skip(f"cannot build C driver: {r.stderr[-400:]}")
+    return exe, lib
+
+
+def test_c_predict_serves_artifact(tmp_path):
+    exe, _ = _build()
+
+    # export a small trained-ish model
+    net = mx.symbol.SoftmaxOutput(
+        data=mx.symbol.FullyConnected(
+            data=mx.symbol.Activation(
+                data=mx.symbol.FullyConnected(
+                    data=mx.symbol.Variable("data"), num_hidden=16,
+                    name="fc1"),
+                act_type="relu"),
+            num_hidden=5, name="fc2"),
+        name="softmax")
+    rng = np.random.RandomState(0)
+    arg = {"fc1_weight": mx.nd.array(rng.randn(16, 7).astype(np.float32)),
+           "fc1_bias": mx.nd.array(np.zeros(16, np.float32)),
+           "fc2_weight": mx.nd.array(rng.randn(5, 16).astype(np.float32)),
+           "fc2_bias": mx.nd.array(np.zeros(5, np.float32))}
+    art = str(tmp_path / "model.mxtpu")
+    from mxnet_tpu.predictor import export_model, load_exported
+    export_model(net, arg, {}, {"data": (4, 7)}, art)
+
+    x = rng.rand(4, 7).astype(np.float32)
+    ref = load_exported(art).predict(data=x)[0]
+
+    xin = str(tmp_path / "in.bin")
+    xout = str(tmp_path / "out.bin")
+    x.tofile(xin)
+    # PYTHONPATH points the EMBEDDED interpreter (linked against the
+    # system libpython, which owns its stdlib) at the serving venv's
+    # site-packages for jax; PYTHONHOME must stay unset — venvs carry no
+    # stdlib
+    env = dict(os.environ,
+               PYTHONPATH=sysconfig.get_paths()["purelib"],
+               JAX_PLATFORMS="cpu", MXNET_TPU_TESTS="0")
+    env.pop("PYTHONHOME", None)
+    r = subprocess.run([exe, art, xin, xout], capture_output=True,
+                       text=True, env=env, timeout=300)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert "served 1 outputs ok" in r.stdout, r.stdout
+    got = np.fromfile(xout, np.float32).reshape(4, 5)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+    # softmax rows sum to one — the program really executed
+    np.testing.assert_allclose(got.sum(axis=1), 1.0, rtol=1e-5)
