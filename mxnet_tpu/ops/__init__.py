@@ -10,6 +10,7 @@ from . import simple_ops  # noqa: F401  (registers simple ops)
 from . import nn_ops  # noqa: F401  (registers NN OperatorProperty ops)
 from . import attention_ops  # noqa: F401  (registers attention ops)
 from . import ctc  # noqa: F401  (registers WarpCTC loss head)
+from . import detection_ops  # noqa: F401  (registers Proposal)
 
 __all__ = ["OP_REGISTRY", "OpContext", "OpDef", "OpParam", "get_op",
            "list_ops", "register_op"]

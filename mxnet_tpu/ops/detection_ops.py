@@ -1,0 +1,201 @@
+"""Detection ops: anchor Proposal generation (rcnn pipeline support).
+
+The reference's rcnn example drives proposal generation through a
+CPU/CUDA op with DYNAMIC output counts (``example/rcnn/rcnn/symbol.py``
++ the proposal op's variable post-NMS box list).  Data-dependent shapes
+don't exist under XLA, so this is the TPU-first redesign of the same
+machinery: every stage is **fixed-size** — `lax.top_k` pre-NMS, an
+iterative fixed-``rpn_post_nms_top_n``-step NMS (`lax.fori_loop` with
+score masking), and a ``[B*K, 5]`` ROI output whose unfilled slots are
+zero-area boxes downstream heads learn to ignore.  Shape
+specialization happens at bind time (K is an op param), not at run
+time — the executor behavior the reference's example exercised with
+re-binds per image size is exercised here by binding per (K,
+image-size) config.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .registry import OpDef, OpParam, register_op
+
+__all__ = ["generate_anchors", "bbox_transform_inv", "fixed_nms"]
+
+
+def generate_anchors(feature_stride: int, scales, ratios, height: int,
+                     width: int) -> np.ndarray:
+    """All anchors for an H x W feature map: ``[H*W*A, 4]`` (x1,y1,x2,y2),
+    A = len(scales) * len(ratios); same base-anchor recipe as the rcnn
+    literature (centered at each stride cell, area = (stride*scale)^2,
+    aspect = ratio)."""
+    base = float(feature_stride)
+    anchors = []
+    for r in ratios:
+        for s in scales:
+            area = (base * s) ** 2
+            w = np.sqrt(area / r)
+            h = w * r
+            anchors.append([-w / 2, -h / 2, w / 2, h / 2])
+    base_anchors = np.asarray(anchors, np.float32)        # [A, 4]
+    sx = (np.arange(width) + 0.5) * feature_stride
+    sy = (np.arange(height) + 0.5) * feature_stride
+    cx, cy = np.meshgrid(sx, sy)                          # [H, W]
+    centers = np.stack([cx, cy, cx, cy], axis=-1).reshape(-1, 1, 4)
+    return (centers + base_anchors[None]).reshape(-1, 4).astype(np.float32)
+
+
+def bbox_transform_inv(anchors, deltas):
+    """Decode (dx, dy, dw, dh) deltas against anchors -> boxes
+    (+1 width convention, exact identity for zero deltas)."""
+    aw = anchors[:, 2] - anchors[:, 0] + 1.0
+    ah = anchors[:, 3] - anchors[:, 1] + 1.0
+    acx = anchors[:, 0] + 0.5 * (aw - 1.0)
+    acy = anchors[:, 1] + 0.5 * (ah - 1.0)
+    cx = deltas[:, 0] * aw + acx
+    cy = deltas[:, 1] * ah + acy
+    w = jnp.exp(jnp.clip(deltas[:, 2], -10.0, 10.0)) * aw
+    h = jnp.exp(jnp.clip(deltas[:, 3], -10.0, 10.0)) * ah
+    return jnp.stack([cx - 0.5 * (w - 1.0), cy - 0.5 * (h - 1.0),
+                      cx + 0.5 * (w - 1.0), cy + 0.5 * (h - 1.0)], axis=1)
+
+
+def _iou_one_many(box, boxes):
+    x1 = jnp.maximum(box[0], boxes[:, 0])
+    y1 = jnp.maximum(box[1], boxes[:, 1])
+    x2 = jnp.minimum(box[2], boxes[:, 2])
+    y2 = jnp.minimum(box[3], boxes[:, 3])
+    inter = jnp.maximum(x2 - x1 + 1, 0) * jnp.maximum(y2 - y1 + 1, 0)
+    a1 = ((box[2] - box[0] + 1) * (box[3] - box[1] + 1))
+    a2 = ((boxes[:, 2] - boxes[:, 0] + 1) * (boxes[:, 3] - boxes[:, 1] + 1))
+    return inter / jnp.maximum(a1 + a2 - inter, 1e-6)
+
+
+def fixed_nms(boxes, scores, k: int, iou_threshold: float):
+    """Fixed-output-size NMS: exactly ``k`` boxes out.
+
+    ``k`` iterations of select-argmax / suppress-overlaps — the
+    static-shape answer to dynamic NMS (no data-dependent output
+    count).  Returns ``(boxes [k, 4], scores [k])``; once every real
+    candidate is consumed the remaining slots carry -inf scores and
+    zero boxes.
+    """
+    n = boxes.shape[0]
+
+    neg_inf = jnp.asarray(-jnp.inf, scores.dtype)
+
+    def body(i, carry):
+        live_scores, out_boxes, out_scores = carry
+        j = jnp.argmax(live_scores)
+        best = live_scores[j]
+        box = boxes[j]
+        valid = best > neg_inf
+        out_boxes = out_boxes.at[i].set(
+            jnp.where(valid, box, jnp.zeros(4, boxes.dtype)))
+        out_scores = out_scores.at[i].set(jnp.where(valid, best, neg_inf))
+        iou = _iou_one_many(box, boxes)
+        suppress = (iou > iou_threshold) | (jnp.arange(n) == j)
+        live_scores = jnp.where(valid & suppress, neg_inf, live_scores)
+        return live_scores, out_boxes, out_scores
+
+    out = (scores, jnp.zeros((k, 4), boxes.dtype),
+           jnp.full((k,), -jnp.inf, scores.dtype))
+    _, out_boxes, out_scores = jax.lax.fori_loop(0, k, body, out)
+    return out_boxes, out_scores
+
+
+def _proposal_fwd(ctx, params, cls_prob, bbox_pred, im_info):
+    stride = params["feature_stride"]
+    scales = params["scales"]
+    ratios = params["ratios"]
+    pre_n = params["rpn_pre_nms_top_n"]
+    post_n = params["rpn_post_nms_top_n"]
+    thresh = params["threshold"]
+    min_size = params["rpn_min_size"]
+
+    b, twoa, h, w = cls_prob.shape
+    a = len(scales) * len(ratios)
+    anchors = jnp.asarray(generate_anchors(stride, scales, ratios, h, w))
+
+    def one(img_scores, img_deltas, info):
+        # fg scores: channels [A:2A]; layout [A, H, W] -> [H*W*A]
+        fg = img_scores[a:].transpose(1, 2, 0).reshape(-1)
+        deltas = img_deltas.reshape(a, 4, h, w).transpose(2, 3, 0, 1)
+        deltas = deltas.reshape(-1, 4)
+        boxes = bbox_transform_inv(anchors, deltas)
+        # clip to image
+        im_h, im_w = info[0], info[1]
+        boxes = jnp.stack([
+            jnp.clip(boxes[:, 0], 0, im_w - 1),
+            jnp.clip(boxes[:, 1], 0, im_h - 1),
+            jnp.clip(boxes[:, 2], 0, im_w - 1),
+            jnp.clip(boxes[:, 3], 0, im_h - 1)], axis=1)
+        ws = boxes[:, 2] - boxes[:, 0] + 1
+        hs = boxes[:, 3] - boxes[:, 1] + 1
+        # min_size is in ORIGINAL-image pixels: scale by im_info[2]
+        # (the resize factor), matching the reference proposal filter
+        min_sz = min_size * info[2]
+        keep = (ws >= min_sz) & (hs >= min_sz)
+        fg = jnp.where(keep, fg, -jnp.inf)
+        top = min(pre_n, fg.shape[0])
+        top_scores, top_idx = jax.lax.top_k(fg, top)
+        top_boxes = boxes[top_idx]
+        nms_boxes, nms_scores = fixed_nms(top_boxes, top_scores, post_n,
+                                          thresh)
+        return nms_boxes, nms_scores
+
+    boxes, scores = jax.vmap(one)(cls_prob, bbox_pred, im_info)
+    batch_idx = jnp.repeat(jnp.arange(b, dtype=boxes.dtype), post_n)
+    rois = jnp.concatenate([batch_idx[:, None],
+                            boxes.reshape(b * post_n, 4)], axis=1)
+    # proposals are sample selections, not differentiable outputs
+    rois = jax.lax.stop_gradient(rois)
+    scores = jax.lax.stop_gradient(scores.reshape(b * post_n))
+    if params["output_score"]:
+        return rois, scores
+    return rois
+
+
+def _proposal_shape(params, in_shapes):
+    cls, bbox, info = (list(in_shapes) + [None] * 3)[:3]
+    if cls is None:
+        outs = [None, None] if params["output_score"] else [None]
+        return in_shapes, outs, []
+    b, twoa, h, w = cls
+    a = len(params["scales"]) * len(params["ratios"])
+    if twoa != 2 * a:
+        from ..base import MXNetError
+        raise MXNetError(f"Proposal: cls_prob channels {twoa} != 2*A "
+                         f"(A={a} from scales x ratios)")
+    k = params["rpn_post_nms_top_n"]
+    outs = ([(b * k, 5), (b * k,)] if params["output_score"]
+            else [(b * k, 5)])
+    return [tuple(cls), (b, 4 * a, h, w), (b, 3)], outs, []
+
+
+register_op(OpDef(
+    name="Proposal",
+    forward=_proposal_fwd,
+    arguments=("cls_prob", "bbox_pred", "im_info"),
+    outputs=lambda p: (["output", "score"] if p["output_score"]
+                       else ["output"]),
+    params={
+        "feature_stride": OpParam("feature_stride", "int", default=16),
+        "scales": OpParam("scales", "floats", default=(8.0, 16.0, 32.0)),
+        "ratios": OpParam("ratios", "floats", default=(0.5, 1.0, 2.0)),
+        "rpn_pre_nms_top_n": OpParam("rpn_pre_nms_top_n", "int",
+                                     default=512),
+        "rpn_post_nms_top_n": OpParam("rpn_post_nms_top_n", "int",
+                                      default=16),
+        "threshold": OpParam("threshold", "float", default=0.7),
+        "rpn_min_size": OpParam("rpn_min_size", "int", default=4),
+        "output_score": OpParam("output_score", "bool", default=False),
+    },
+    infer_shape=_proposal_shape,
+    doc="RPN proposal generation: decode anchor deltas, clip, fixed-K "
+        "NMS -> [B*K, 5] rois (batch_idx, x1, y1, x2, y2).  All shapes "
+        "static (TPU-first redesign of the reference's dynamic-count "
+        "proposal op).",
+))

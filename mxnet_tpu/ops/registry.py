@@ -50,14 +50,22 @@ def _parse_bool(v) -> bool:
     raise ValueError(f"cannot parse bool from {v!r}")
 
 
-def _parse_shape(v) -> Tuple[int, ...]:
-    if isinstance(v, (tuple, list)):
-        return tuple(int(x) for x in v)
-    s = str(v).strip()
-    val = ast.literal_eval(s)
-    if isinstance(val, (int, float)):
-        return (int(val),)
-    return tuple(int(x) for x in val)
+def _parse_tuple(cast):
+    def parse(v):
+        if isinstance(v, (tuple, list)):
+            return tuple(cast(x) for x in v)
+        val = ast.literal_eval(str(v).strip())
+        if isinstance(val, (int, float)):
+            return (cast(val),)
+        return tuple(cast(x) for x in val)
+    return parse
+
+
+_parse_shape = _parse_tuple(int)
+
+
+# float tuples (anchor ratios/scales — 'shape' would truncate 0.5)
+_parse_floats = _parse_tuple(float)
 
 
 _PARAM_PARSERS: Dict[str, Callable[[Any], Any]] = {
@@ -66,6 +74,7 @@ _PARAM_PARSERS: Dict[str, Callable[[Any], Any]] = {
     "bool": _parse_bool,
     "str": str,
     "shape": _parse_shape,
+    "floats": _parse_floats,
 }
 
 
