@@ -309,12 +309,13 @@ def main():
     if (args.batch_size, args.image_shape, args.num_classes) != (256, "3,28,28", 10):
         print("note: default suite uses fixed configs; pass --network to "
               "apply --batch-size/--image-shape/--num-classes", file=sys.stderr)
+    # two rows only — the suite must finish inside the driver's window
+    # and the driver parses the LAST line (resnet, the north star).
+    # Other configs run via --network; round-4 measurements for them
+    # (inception-bn 224^2 = 47.5x the best single-GPU ImageNet epoch,
+    # flash-attention LM rows) are recorded in docs/perf.md + README.
     bench_image(args, network="inception-bn-28-small",
                 image_shape="3,28,28", batch=256, num_classes=10)
-    # ImageNet-shape Inception-BN: vs_baseline is the epoch-time-
-    # equivalent ratio against the reference's best single-GPU epoch
-    bench_image(args, network="inception-bn", image_shape="3,224,224",
-                batch=128, num_classes=1000)
     bench_image(args, network="resnet", image_shape="3,224,224",
                 batch=256, num_classes=1000)
     return 0
