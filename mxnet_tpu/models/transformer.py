@@ -53,12 +53,16 @@ def transformer_block(x, b, l, d, heads, name, causal=True):
 
 
 def transformer_lm(vocab_size=256, num_layers=2, d_model=64, heads=4,
-                   batch_size=8, seq_len=64, causal=True, remat=False):
+                   batch_size=8, seq_len=64, causal=True, remat=False,
+                   head_same_dtype=False):
     """Build the LM symbol; inputs ``data``/``softmax_label`` are
     ``[batch, seq]`` token ids.  ``remat=True`` wraps each block in a
     ``remat_scope`` so backward recomputes the block from its boundary
     activations (jax.checkpoint over the subgraph) — the memory lever
-    that fits 32k-token training on one chip."""
+    that fits 32k-token training on one chip.  ``head_same_dtype=True``
+    emits the softmax head's probabilities in the activation dtype
+    (bf16 under AMP — halves the [B*L, vocab] head-output HBM, the
+    other 32k lever; loss math stays f32)."""
     b, l, d = batch_size, seq_len, d_model
     net = sym.Embedding(data=sym.Variable("data"), input_dim=vocab_size,
                         output_dim=d, name="embed")
@@ -72,4 +76,5 @@ def transformer_lm(vocab_size=256, num_layers=2, d_model=64, heads=4,
     net = sym.Reshape(data=net, shape=(b * l, d))
     net = sym.FullyConnected(data=net, num_hidden=vocab_size, name="lm_head")
     label = sym.Reshape(data=sym.Variable("softmax_label"), shape=(b * l,))
-    return sym.SoftmaxOutput(data=net, label=label, name="softmax")
+    return sym.SoftmaxOutput(data=net, label=label, name="softmax",
+                             out_dtype="same" if head_same_dtype else "")

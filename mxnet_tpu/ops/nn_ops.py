@@ -890,7 +890,7 @@ def _softmax_rows(x):
 
 
 def _softmax_output_core(data, label, grad_scale, ignore_label, multi_output,
-                         use_ignore, normalization):
+                         use_ignore, normalization, out_dtype=""):
     # loss heads compute in >=f32 regardless of the activation dtype (AMP
     # policy: softmax/log in bf16 destroys small probabilities).  The
     # cast happens INSIDE fwd/bwd so the residual keeps the ORIGINAL
@@ -899,10 +899,20 @@ def _softmax_output_core(data, label, grad_scale, ignore_label, multi_output,
 
     @jax.custom_vjp
     def _fn(data, label):
+        in_dtype = data.dtype
         data = _amp_f32(data)
         if multi_output and data.ndim > 2:
-            return jax.nn.softmax(data, axis=1)
-        return _softmax_rows(data)
+            prob = jax.nn.softmax(data, axis=1)
+        else:
+            prob = _softmax_rows(data)
+        # out_dtype='same': emit probs in the INPUT dtype.  Softmax/log
+        # still compute in f32; only the OUTPUT buffer shrinks — at a
+        # [B*L, 32000] LM head under bf16 AMP that's the difference
+        # between a 4.2 GB and a 2.1 GB head output per step (the 32k-
+        # token single-chip limiter, docs/perf.md)
+        if out_dtype == "same":
+            prob = prob.astype(in_dtype)
+        return prob
 
     def _fwd(data, label):
         return _fn(data, label), (data, label)
@@ -963,6 +973,11 @@ _SOFTMAX_OUT_PARAMS = {
     "use_ignore": OpParam("use_ignore", "bool", default=False),
     "normalization": OpParam("normalization", "str", default="null",
                              enum=("null", "batch", "valid")),
+    "out_dtype": OpParam("out_dtype", "str", default="",
+                         enum=("", "same"),
+                         doc="'same' emits probabilities in the input "
+                             "dtype (halves the head-output HBM under "
+                             "bf16 AMP; compute stays f32)"),
 }
 
 for _name in ("SoftmaxOutput", "Softmax"):  # "Softmax" is the deprecated alias
@@ -971,7 +986,7 @@ for _name in ("SoftmaxOutput", "Softmax"):  # "Softmax" is the deprecated alias
         forward=lambda ctx, params, data, label: _softmax_output_core(
             data, label, params["grad_scale"], params["ignore_label"],
             params["multi_output"], params["use_ignore"],
-            params["normalization"]),
+            params["normalization"], params["out_dtype"]),
         arguments=("data", "label"),
         params=dict(_SOFTMAX_OUT_PARAMS),
         infer_shape=_softmax_output_shape,

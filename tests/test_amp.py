@@ -70,3 +70,35 @@ def test_amp_eval_matches_train_graph():
     prob = np.asarray(out[0])
     assert prob.shape == (b, 2)
     np.testing.assert_allclose(prob.sum(axis=1), 1.0, rtol=2e-3)
+
+
+def test_softmax_output_same_dtype_head():
+    """out_dtype='same' emits bf16 probs (half the head-output HBM at LM
+    scale) while loss math and gradients stay f32-accurate."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from mxnet_tpu.ops.nn_ops import _softmax_output_core
+
+    rng = np.random.RandomState(0)
+    logits = jnp.asarray(rng.randn(8, 32).astype(np.float32)).astype(
+        jnp.bfloat16)
+    label = jnp.asarray(rng.randint(0, 32, (8,)).astype(np.float32))
+
+    def head(out_dtype):
+        def f(x):
+            p = _softmax_output_core(x, label, 1.0, -1.0, False, False,
+                                     "null", out_dtype)
+            return p, jnp.sum(p.astype(jnp.float32))
+        (probs, _), vjp = jax.vjp(lambda x: f(x), logits, has_aux=False)
+        g = vjp((jnp.ones_like(probs), jnp.float32(1.0)))[0]
+        return probs, np.asarray(g, np.float32)
+
+    out_same, g_same = head("same")
+    out_f32, g_f32 = head("")
+    assert out_same.dtype == jnp.bfloat16, out_same.dtype
+    assert out_f32.dtype == jnp.float32, out_f32.dtype
+    np.testing.assert_allclose(np.asarray(out_same, np.float32),
+                               np.asarray(out_f32), rtol=2e-2, atol=2e-3)
+    # loss-head backward computes from the saved logits in f32 either way
+    np.testing.assert_allclose(g_same, g_f32, rtol=1e-5, atol=1e-6)
