@@ -34,6 +34,13 @@ TPU-native equivalent here:
   GPipe's all-M wavefront), and boundary tensors/cotangents move between
   stage meshes with a single resharding ``device_put``.
 
+Scaling note: the schedule is HOST-driven — ~2·S·M compiled calls per
+step.  JAX async dispatch keeps the per-stage device queues full on
+normal hosts (dispatch is tens of µs), but on very-high-latency
+control planes prefer larger microbatches, or the single-program SPMD
+fast path (:func:`pipeline_apply`) when stages are homogeneous; a fully
+compiled ``shard_map``-over-``pipe`` schedule is the eventual endgame.
+
 Cross-stage tensors travel in an "env" dict keyed ``"node#out_idx"`` —
 skip connections that jump stages simply ride the env through the
 intermediate stages, and their cotangents accumulate automatically
