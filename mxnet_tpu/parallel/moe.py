@@ -138,8 +138,9 @@ def moe_ffn_ep(x, gate_w, w1, b1, w2, b2, mesh, k: int = 2,
     :func:`switch_ffn`; ``k>1`` renormalizes over the chosen k like
     :func:`moe_ffn`.
     """
-    from jax.experimental.shard_map import shard_map
     from functools import partial
+
+    from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
     ep = mesh.shape[expert_axis]
