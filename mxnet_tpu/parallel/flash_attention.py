@@ -111,8 +111,9 @@ def _flash_fwd_pallas(q, k, v, causal, scale, bq, bk, interpret=False):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    bh, l, d = q.shape
-    nq, nk = l // bq, l // bk
+    bh, lq, d = q.shape
+    lk = k.shape[1]
+    nq, nk = lq // bq, lk // bk
     kern = functools.partial(_fwd_kernel, causal, scale, bq, bk, d)
     with jax.enable_x64(False):
         return pl.pallas_call(
@@ -133,8 +134,8 @@ def _flash_fwd_pallas(q, k, v, causal, scale, bq, bk, interpret=False):
                              memory_space=pltpu.VMEM),
             ],
             out_shape=[
-                jax.ShapeDtypeStruct((bh, l, d), q.dtype),
-                jax.ShapeDtypeStruct((bh, 8, l), jnp.float32),
+                jax.ShapeDtypeStruct((bh, lq, d), q.dtype),
+                jax.ShapeDtypeStruct((bh, 8, lq), jnp.float32),
             ],
             scratch_shapes=[
                 pltpu.VMEM((bq, 128), jnp.float32),   # running max
@@ -262,13 +263,14 @@ def _flash_bwd_pallas(q, k, v, out, lse, do, causal, scale, bq, bk,
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    bh, l, d = q.shape
-    nq, nk = l // bq, l // bk
+    bh, lq, d = q.shape
+    lk = k.shape[1]
+    nq, nk = lq // bq, lk // bk
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
-                    axis=-1)                            # [BH, L]
+                    axis=-1)                            # [BH, Lq]
     # row stats enter as 8-sublane broadcasts (Mosaic block constraint)
-    lse8 = jnp.broadcast_to(lse[:, None, :], (bh, 8, l))
-    delta8 = jnp.broadcast_to(delta[:, None, :], (bh, 8, l))
+    lse8 = jnp.broadcast_to(lse[:, None, :], (bh, 8, lq))
+    delta8 = jnp.broadcast_to(delta[:, None, :], (bh, 8, lq))
 
     qspec = pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0),
                          memory_space=pltpu.VMEM)
@@ -282,7 +284,7 @@ def _flash_bwd_pallas(q, k, v, out, lse, do, causal, scale, bq, bk,
             grid=(bh, nq, nk),
             in_specs=[qspec, kspec, kspec, qspec, rowq, rowq],
             out_specs=[qspec],
-            out_shape=[jax.ShapeDtypeStruct((bh, l, d), q.dtype)],
+            out_shape=[jax.ShapeDtypeStruct((bh, lq, d), q.dtype)],
             scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
             compiler_params=pltpu.CompilerParams(
                 dimension_semantics=("parallel", "parallel", "arbitrary")),
@@ -301,8 +303,8 @@ def _flash_bwd_pallas(q, k, v, out, lse, do, causal, scale, bq, bk,
             grid=(bh, nk, nq),
             in_specs=[qspec2, kspec2, kspec2, qspec2, rowq2, rowq2],
             out_specs=[kspec2, kspec2],
-            out_shape=[jax.ShapeDtypeStruct((bh, l, d), k.dtype),
-                       jax.ShapeDtypeStruct((bh, l, d), v.dtype)],
+            out_shape=[jax.ShapeDtypeStruct((bh, lk, d), k.dtype),
+                       jax.ShapeDtypeStruct((bh, lk, d), v.dtype)],
             scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
                             pltpu.VMEM((bk, d), jnp.float32)],
             compiler_params=pltpu.CompilerParams(
@@ -361,7 +363,9 @@ def flash_attention(q, k, v, *, causal=False, scale=None,
 
     kernel_ok = (
         bq is not None and bk is not None
-        and lq == lk                      # self-attention layout
+        # causal masking assumes aligned q/k positions; plain
+        # cross-attention (lq != lk) is fine without it
+        and (lq == lk or not causal)
         and lq % bq == 0 and lk % bk == 0  # grid truncates otherwise
         and bq >= 64 and bk >= 64
         and d <= 256

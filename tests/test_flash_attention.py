@@ -105,3 +105,31 @@ def test_flash_nondividing_explicit_blocks_fall_back():
     ref = local_attention(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("lq,lk", [(128, 256), (256, 128)])
+def test_flash_cross_attention_interpret(lq, lk):
+    """Non-causal cross-attention (lq != lk) runs through the kernel."""
+    rng = np.random.RandomState(17)
+    mk = lambda l: jnp.asarray(rng.randn(1, 2, l, 64).astype(np.float32)
+                               * 0.3)
+    q, k, v = mk(lq), mk(lk), mk(lk)
+    y = flash_attention(q, k, v, causal=False, block_q=64, block_k=64,
+                        interpret=True)
+    ref = local_attention(q, k, v, causal=False)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(jnp.square(flash_attention(
+            q, k, v, causal=False, block_q=64, block_k=64,
+            interpret=True)))
+
+    def loss_dense(q, k, v):
+        return jnp.sum(jnp.square(local_attention(q, k, v, causal=False)))
+
+    gf = jax.grad(loss_flash, (0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, (0, 1, 2))(q, k, v)
+    for a, b, n in zip(gf, gd, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4, err_msg=f"d{n}")
