@@ -257,6 +257,13 @@ def test_pipeline_composes_with_data_parallel():
     for n, v in ref._params.items():
         np.testing.assert_allclose(arg_pp[n].asnumpy(), np.asarray(v),
                                    rtol=2e-4, atol=2e-4, err_msg=n)
+    # eval path under dp x pp matches the reference forward too
+    ev = _batches(shapes, 1, seed=9)[0]
+    out_pp_f = pp.forward(ev)
+    out_ref_f = ref.forward(ev)
+    np.testing.assert_allclose(np.asarray(out_pp_f[0]),
+                               np.asarray(out_ref_f[0]),
+                               rtol=2e-5, atol=2e-5)
 
 
 def test_pipeline_1f1b_caps_inflight():
