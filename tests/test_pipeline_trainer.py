@@ -301,3 +301,36 @@ def test_pipeline_1f1b_caps_inflight():
     assert np.all(np.isfinite(np.asarray(out[0])))
     for s in range(S):
         assert peak[s] <= S - s, (s, peak, "1F1B cap violated")
+
+
+def test_pipeline_dp_with_grouped_stages():
+    """dp=2 composes with explicit ctx_group stage assignment too."""
+    import jax
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    shapes = {"data": (16, 20), "softmax_label": (16,)}
+    sym = _mlp4_grouped()
+    arg_params = _init(sym, shapes)
+    pp = PipelineTrainer(sym, num_stages=4, num_microbatches=4,
+                         data_parallel=2,
+                         group2stage={f"stage{i}": i for i in range(4)},
+                         optimizer="sgd",
+                         optimizer_params={"learning_rate": 0.5,
+                                           "momentum": 0.9})
+    pp.bind(data_shapes={"data": shapes["data"]},
+            label_shapes={"softmax_label": shapes["softmax_label"]},
+            arg_params=arg_params)
+    ref = ShardedTrainer(sym, mesh=make_mesh({"data": 1},
+                                             [jax.devices()[0]]),
+                         optimizer="sgd",
+                         optimizer_params={"learning_rate": 0.5,
+                                           "momentum": 0.9})
+    ref.bind(data_shapes={"data": shapes["data"]},
+             label_shapes={"softmax_label": shapes["softmax_label"]},
+             arg_params=arg_params)
+    for b in _batches(shapes, 2):
+        out_pp = pp.step(b)
+        out_ref = ref.step(b)
+    np.testing.assert_allclose(np.asarray(out_pp[0]),
+                               np.asarray(out_ref[0]),
+                               rtol=2e-5, atol=2e-5)
