@@ -1,4 +1,15 @@
-"""Training callbacks (reference ``python/mxnet/callback.py``)."""
+"""Training callbacks (reference ``python/mxnet/callback.py``).
+
+Same four entry points and the exact log format strings the reference
+emits (``tools/parse_log.py`` greps Speedometer's
+``Epoch[..] .. Speed: .. samples/sec .. Train-<name>=<val>`` lines; the
+``Iter[..]`` forms are reference-parity only), with the internals built
+around this
+codebase's fit() loop: callbacks receive a ``BatchEndParam``-style record
+whose ``nbatch`` rewinds at epoch boundaries, and metric drains happen
+lazily at ``get_name_value()`` (parallel/trainer.py), so the meter only
+forces a metric sync at emit cadence.
+"""
 from __future__ import annotations
 
 import logging
@@ -9,7 +20,8 @@ __all__ = ["do_checkpoint", "log_train_metric", "Speedometer", "ProgressBar"]
 
 
 def do_checkpoint(prefix: str):
-    """Epoch-end checkpoint callback (reference ``callback.py:11``)."""
+    """Epoch-end callback saving ``prefix-%04d.params`` (reference
+    ``callback.py:11``)."""
 
     def _callback(iter_no, sym, arg, aux):
         from .model import save_checkpoint
@@ -19,62 +31,73 @@ def do_checkpoint(prefix: str):
 
 
 def log_train_metric(period: int, auto_reset: bool = False):
-    """Log metric every `period` batches (reference ``callback.py:34``)."""
+    """Batch-end callback logging the running metric every ``period``
+    batches (reference ``callback.py:34``)."""
 
     def _callback(param):
-        if param.nbatch % period == 0 and param.eval_metric is not None:
-            name_value = param.eval_metric.get_name_value()
-            for name, value in name_value:
-                logging.info("Iter[%d] Batch[%d] Train-%s=%f",
-                             param.epoch, param.nbatch, name, value)
-            if auto_reset:
-                param.eval_metric.reset()
+        if param.nbatch % period or param.eval_metric is None:
+            return
+        for name, value in param.eval_metric.get_name_value():
+            logging.info("Iter[%d] Batch[%d] Train-%s=%f",
+                         param.epoch, param.nbatch, name, value)
+        if auto_reset:
+            param.eval_metric.reset()
 
     return _callback
 
 
 class Speedometer:
-    """Samples/sec logging (reference ``callback.py:61``)."""
+    """Throughput meter: logs samples/sec every ``frequent`` batches
+    (reference ``callback.py:61``).
+
+    Keeps one timing mark (`perf_counter` at the last emit or rewind) and
+    derives speed from the wall time the current ``frequent``-batch window
+    took.  A batch counter that moves backwards means a new epoch started:
+    the mark is re-armed and nothing is emitted for the partial window.
+    """
 
     def __init__(self, batch_size: int, frequent: int = 50):
         self.batch_size = batch_size
         self.frequent = frequent
-        self.init = False
-        self.tic = 0.0
-        self.last_count = 0
+        self._mark: float | None = None  # perf_counter at window start
+        self._mark_batch = 0
 
     def __call__(self, param):
-        count = param.nbatch
-        if self.last_count > count:
-            self.init = False
-        self.last_count = count
-        if self.init:
-            if count % self.frequent == 0:
-                speed = self.frequent * self.batch_size / (time.time() - self.tic)
-                if param.eval_metric is not None:
-                    name_value = param.eval_metric.get_name_value()
-                    for name, value in name_value:
-                        logging.info("Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec\tTrain-%s=%f",
-                                     param.epoch, count, speed, name, value)
-                else:
-                    logging.info("Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
-                                 param.epoch, count, speed)
-                self.tic = time.time()
-        else:
-            self.init = True
-            self.tic = time.time()
+        now = time.perf_counter()
+        rewound = param.nbatch < self._mark_batch
+        self._mark_batch = param.nbatch
+        if self._mark is None or rewound:
+            self._mark = now
+            return
+        if param.nbatch % self.frequent:
+            return
+        elapsed = max(now - self._mark, 1e-12)
+        speed = self.frequent * self.batch_size / elapsed
+        self._emit(param, speed)
+        self._mark = now
+
+    def _emit(self, param, speed):
+        # the Epoch[..] line is parse_log.py's SPEED_RE/TRAIN_RE contract
+        if param.eval_metric is None:
+            logging.info("Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
+                         param.epoch, param.nbatch, speed)
+            return
+        for name, value in param.eval_metric.get_name_value():
+            logging.info(
+                "Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec\tTrain-%s=%f",
+                param.epoch, param.nbatch, speed, name, value)
 
 
 class ProgressBar:
-    """Batch progress bar (reference ``callback.py:103``)."""
+    """In-place ``[====----] NN%`` bar over a known epoch length
+    (reference ``callback.py:103``)."""
 
     def __init__(self, total: int, length: int = 80):
         self.bar_len = length
-        self.total = total
+        self.total = max(total, 1)
 
     def __call__(self, param):
-        count = param.nbatch
-        filled_len = int(round(self.bar_len * count / float(self.total)))
-        percents = int(round(100.0 * count / float(self.total)))
-        prog_bar = "=" * filled_len + "-" * (self.bar_len - filled_len)
-        sys.stdout.write(f"[{prog_bar}] {percents}%\r")
+        frac = min(max(param.nbatch / float(self.total), 0.0), 1.0)
+        ticks = round(self.bar_len * frac)
+        bar = "=" * ticks + "-" * (self.bar_len - ticks)
+        sys.stdout.write(f"[{bar}] {round(100 * frac)}%\r")

@@ -1,0 +1,244 @@
+"""Per-shape conv fwd/dgrad/wgrad probe on the real chip.
+
+Times every distinct ResNet-50 conv shape (batch 256, bf16) three ways:
+
+* ``fwd``    — ``lax.conv_general_dilated`` as the framework runs it;
+* ``dgrad``  — input gradient, XLA's own VJP lowering;
+* ``wgrad``  — weight gradient, XLA's own VJP lowering;
+
+plus candidate replacements where the XLA lowering is suspected weak
+(reference analog: the hand-tuned backward paths the 2016 framework got
+from cuDNN, src/operator/cudnn_convolution-inl.h):
+
+* ``dgrad_phase`` — stride-2 input gradient decomposed into 4 phase
+  convolutions (no lhs_dilation: XLA's transposed-conv lowering inserts
+  zeros, wasting 3/4 of the MXU MACs at stride 2);
+* ``wgrad_mm``    — 1x1 wgrad as a plain dot_general over N*H*W.
+
+Timing: chained ``fori_loop`` with an iteration-dependent input scale
+(prevents hoisting; the scalar multiply fuses into the conv), one
+device->host scalar fetch at the end, two-point slope over loop counts
+to cancel the tunnel round-trip (docs/perf.md).
+
+Usage: python tools/conv_probe.py [--filter 3x3_s2] [--iters 4 12]
+"""
+import argparse
+import json
+import time
+
+import numpy as np
+
+# (name, cin, hw_in, cout, k, stride, pad, count_in_resnet50)
+RESNET50_SHAPES = [
+    ("stem_7x7_s2", 3, 224, 64, 7, 2, 3, 1),
+    ("s1_1x1_64_64", 64, 56, 64, 1, 1, 0, 1),
+    ("s1_3x3_64", 64, 56, 64, 3, 1, 1, 3),
+    ("s1_1x1_64_256", 64, 56, 256, 1, 1, 0, 4),
+    ("s1_1x1_256_64", 256, 56, 64, 1, 1, 0, 2),
+    ("s2_1x1_256_128", 256, 56, 128, 1, 1, 0, 1),
+    ("s2_3x3_128_s2", 128, 56, 128, 3, 2, 1, 1),
+    ("s2_1x1_sc_s2", 256, 56, 512, 1, 2, 0, 1),
+    ("s2_1x1_128_512", 128, 28, 512, 1, 1, 0, 4),
+    ("s2_1x1_512_128", 512, 28, 128, 1, 1, 0, 3),
+    ("s2_3x3_128", 128, 28, 128, 3, 1, 1, 3),
+    ("s3_1x1_512_256", 512, 28, 256, 1, 1, 0, 1),
+    ("s3_3x3_256_s2", 256, 28, 256, 3, 2, 1, 1),
+    ("s3_1x1_sc_s2", 512, 28, 1024, 1, 2, 0, 1),
+    ("s3_1x1_256_1024", 256, 14, 1024, 1, 1, 0, 6),
+    ("s3_1x1_1024_256", 1024, 14, 256, 1, 1, 0, 5),
+    ("s3_3x3_256", 256, 14, 256, 3, 1, 1, 5),
+    ("s4_1x1_1024_512", 1024, 14, 512, 1, 1, 0, 1),
+    ("s4_3x3_512_s2", 512, 14, 512, 3, 2, 1, 1),
+    ("s4_1x1_sc_s2", 1024, 14, 2048, 1, 2, 0, 1),
+    ("s4_1x1_512_2048", 512, 7, 2048, 1, 1, 0, 3),
+    ("s4_1x1_2048_512", 2048, 7, 512, 1, 1, 0, 2),
+    ("s4_3x3_512", 512, 7, 512, 3, 1, 1, 2),
+]
+
+
+def make_timer(op, primary, rest):
+    """jitted t(n): run op n times chained through an iteration-dependent
+    scale on the primary operand; returns a scalar."""
+    import jax
+    import jax.numpy as jnp
+
+    def chain(n, primary, *rest):
+        def body(i, acc):
+            scale = (1.0 + 1e-12 * i).astype(primary.dtype)
+            out = op(primary * scale, *rest)
+            return acc + out.ravel()[0].astype(jnp.float32)
+        return jax.lax.fori_loop(0, n, body, jnp.float32(0.0))
+
+    fn = jax.jit(chain)
+    def t_of_n(n):
+        t0 = time.perf_counter()
+        v = fn(n, primary, *rest)
+        np.asarray(v)  # forced fetch = true sync
+        return time.perf_counter() - t0
+    return t_of_n
+
+
+def slope(t_of_n, n1, n2, reps=3):
+    """Median two-point slope in seconds per op."""
+    t_of_n(n1)  # compile+warm
+    out = []
+    for _ in range(reps):
+        t1 = t_of_n(n1)
+        t2 = t_of_n(n2)
+        out.append((t2 - t1) / (n2 - n1))
+    ok = sorted(s for s in out if s > 0)
+    return ok[(len(ok) - 1) // 2] if ok else float("nan")
+
+
+def conv_fwd(s, p):
+    import jax
+    def op(x, w):
+        return jax.lax.conv_general_dilated(
+            x, w, window_strides=(s, s), padding=[(p, p), (p, p)],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    return op
+
+
+def variants_for(name, cin, hw, cout, k, s, p, batch, rng):
+    """Yield (variant_name, op, primary, rest, flops_per_call)."""
+    import jax
+    import jax.numpy as jnp
+    ho = (hw + 2 * p - k) // s + 1
+    x = jnp.asarray(rng.standard_normal((batch, cin, hw, hw)), jnp.bfloat16)
+    w = jnp.asarray(rng.standard_normal((cout, cin, k, k)), jnp.bfloat16)
+    dy = jnp.asarray(rng.standard_normal((batch, cout, ho, ho)), jnp.bfloat16)
+    fwd = conv_fwd(s, p)
+    macs = batch * ho * ho * cout * cin * k * k
+    fl = 2.0 * macs
+
+    yield "fwd", fwd, x, (w,), fl
+
+    def dgrad(dy_, w_):
+        _, vjp = jax.vjp(lambda xx: fwd(xx, w_), x)
+        return vjp(dy_)[0]
+    yield "dgrad", dgrad, dy, (w,), fl
+
+    def wgrad(x_, dy_):
+        _, vjp = jax.vjp(lambda ww: fwd(x_, ww), w)
+        return vjp(dy_)[0]
+    yield "wgrad", wgrad, x, (dy,), fl
+
+    if s == 2:
+        # phase-decomposed dgrad: dx split by output parity, 4 stride-1
+        # convs over the kernel-tap parity classes, interleaved back.
+        def dgrad_phase(dy_, w_):
+            return _phase_dgrad(dy_, w_, (batch, cin, hw, hw), k, s, p)
+        yield "dgrad_phase", dgrad_phase, dy, (w,), fl
+
+    if k == 1 and s == 1:
+        def wgrad_mm(x_, dy_):
+            xm = x_.reshape(batch, cin, hw * hw)
+            dym = dy_.reshape(batch, cout, hw * hw)
+            out = jax.lax.dot_general(
+                dym, xm, (((0, 2), (0, 2)), ((), ())),
+                preferred_element_type=jnp.float32)
+            return out.reshape(cout, cin, 1, 1)
+        yield "wgrad_mm", wgrad_mm, x, (dy,), fl
+
+
+def _phase_dgrad(dy, w, x_shape, k, s, p):
+    """dx for a stride-s conv via s*s phase convolutions (no zero insert).
+
+    dx[n,c,h,v] = sum_{o,u,t} dy[n,o,(h+p-u)/s,(v+p-t)/s] * w[o,c,u,t]
+    restricted to (h+p-u) % s == 0.  Group kernel taps by (u%s, t%s): each
+    parity class contributes to one output phase as a STRIDE-1 conv of dy
+    with the flipped tap subset.
+    """
+    import jax
+    import jax.numpy as jnp
+    n, c, hh, ww_ = x_shape
+    phases = []
+    for a in range(s):
+        row = []
+        for b in range(s):
+            # output positions h = a (mod s): taps u with (a+p-u)%s==0
+            u0 = (a + p) % s
+            v0 = (b + p) % s
+            wk = w[:, :, u0::s, v0::s]  # (O, C, ku, kv)
+            ku, kv = wk.shape[2], wk.shape[3]
+            if ku == 0 or kv == 0:
+                row.append(None)  # no taps reach this phase: dx == 0
+                continue
+            # flip spatially + swap I/O -> conv of dy producing dx phase
+            wk = jnp.flip(wk, (2, 3)).transpose(1, 0, 2, 3)  # (C, O, ku, kv)
+            # dx[h] with h = s*i + a pulls dy[(h+p-u)/s] = dy[i + (a+p-u0)/s - j]
+            off = (a + p - u0) // s
+            lo = off - (ku - 1)
+            h_out = -(-hh + a) // s if a < hh else 0  # ceil((hh - a)/s)
+            h_out = (hh - 1 - a) // s + 1
+            w_out = (ww_ - 1 - b) // s + 1
+            offb = (b + p - v0) // s
+            lob = offb - (kv - 1)
+            dyh = dy.shape[2]
+            # padding so that conv output length == h_out with start index lo
+            pad_lo = -lo if lo < 0 else 0
+            crop_lo = lo if lo > 0 else 0
+            hi_need = (h_out - 1) + off  # last dy index touched
+            pad_hi = max(0, hi_need - (dyh - 1))
+            pad_lob = -lob if lob < 0 else 0
+            crop_lob = lob if lob > 0 else 0
+            hib_need = (w_out - 1) + offb
+            pad_hib = max(0, hib_need - (dy.shape[3] - 1))
+            ph = jax.lax.conv_general_dilated(
+                dy, wk, window_strides=(1, 1),
+                padding=[(pad_lo, pad_hi), (pad_lob, pad_hib)],
+                dimension_numbers=("NCHW", "OIHW", "NCHW"))
+            ph = ph[:, :, crop_lo:crop_lo + h_out, crop_lob:crop_lob + w_out]
+            row.append(ph)
+        phases.append(row)
+    # interleave: dx[:, :, s*i+a, s*j+b] = phases[a][b][:, :, i, j]
+    h_max = max(ph.shape[2] for row in phases for ph in row if ph is not None)
+    w_max = max(ph.shape[3] for row in phases for ph in row if ph is not None)
+    stacked = jnp.zeros((n, c, h_max, s, w_max, s), dy.dtype)
+    for a in range(s):
+        for b in range(s):
+            ph = phases[a][b]
+            if ph is None:
+                continue
+            stacked = stacked.at[:, :, :ph.shape[2], a, :ph.shape[3], b].set(ph)
+    return stacked.reshape(n, c, h_max * s, w_max * s)[:, :, :hh, :ww_]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--filter", default="")
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--iters", type=int, nargs=2, default=(4, 12))
+    ap.add_argument("--check", action="store_true",
+                    help="numerically check variants vs XLA on CPU-size data")
+    args = ap.parse_args()
+    import jax
+
+    rng = np.random.default_rng(0)
+    rows = []
+    total = {"fwd": 0.0, "dgrad": 0.0, "wgrad": 0.0, "best_bwd": 0.0}
+    for (name, cin, hw, cout, k, s, p, count) in RESNET50_SHAPES:
+        if args.filter and args.filter not in name:
+            continue
+        best = {}
+        for vname, op, primary, rest, fl in variants_for(
+                name, cin, hw, cout, k, s, p, args.batch, rng):
+            t = slope(make_timer(op, primary, rest), *args.iters)
+            eff = fl / t / 1e12
+            rows.append({"shape": name, "variant": vname,
+                         "ms": round(t * 1e3, 3),
+                         "tflops": round(eff, 1), "count": count})
+            print(json.dumps(rows[-1]), flush=True)
+            best.setdefault(vname.split("_")[0], []).append((t, vname))
+        for base in ("fwd", "dgrad", "wgrad"):
+            if base in best:
+                total[base] += count * min(best[base])[0]
+        bwd = sum(count * min(best[b])[0] for b in ("dgrad", "wgrad")
+                  if b in best)
+        total["best_bwd"] += bwd
+    print(json.dumps({"totals_ms": {k: round(v * 1e3, 2)
+                                    for k, v in total.items()}}))
+
+
+if __name__ == "__main__":
+    main()
