@@ -228,7 +228,7 @@ def bench_lm(args):
         "transformer-lm", vocab_size=vocab, num_layers=args.num_layers,
         d_model=args.d_model, heads=max(1, args.d_model // 64),
         batch_size=b, seq_len=l, remat=args.remat,
-        head_same_dtype=args.head_bf16)
+        head_same_dtype=args.head_bf16, loss_head=args.head_loss)
     trainer = _make_trainer(sym, args.precision, args.compute_dtype,
                             optimizer="adam",
                             optimizer_params={"learning_rate": 1e-3})
@@ -294,6 +294,10 @@ def main():
     ap.add_argument("--head-bf16", action="store_true",
                     help="emit softmax-head probs in the activation dtype "
                     "(halves the [B*L, vocab] head output; 32k lever)")
+    ap.add_argument("--head-loss", action="store_true",
+                    help="loss-only training head: per-token CE output, "
+                    "no [B*L, vocab] probs emitted (identical grads; "
+                    "parity head stays the eval/predict default)")
     ap.add_argument("--vocab", type=int, default=32000)
     ap.add_argument("--d-model", type=int, default=512)
     ap.add_argument("--num-layers", type=int, default=6)

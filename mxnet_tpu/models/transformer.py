@@ -54,7 +54,7 @@ def transformer_block(x, b, l, d, heads, name, causal=True):
 
 def transformer_lm(vocab_size=256, num_layers=2, d_model=64, heads=4,
                    batch_size=8, seq_len=64, causal=True, remat=False,
-                   head_same_dtype=False):
+                   head_same_dtype=False, loss_head=False):
     """Build the LM symbol; inputs ``data``/``softmax_label`` are
     ``[batch, seq]`` token ids.  ``remat=True`` wraps each block in a
     ``remat_scope`` so backward recomputes the block from its boundary
@@ -62,7 +62,11 @@ def transformer_lm(vocab_size=256, num_layers=2, d_model=64, heads=4,
     that fits 32k-token training on one chip.  ``head_same_dtype=True``
     emits the softmax head's probabilities in the activation dtype
     (bf16 under AMP — halves the [B*L, vocab] head-output HBM, the
-    other 32k lever; loss math stays f32)."""
+    other 32k lever; loss math stays f32).  ``loss_head=True`` is the
+    TRAINING head: the symbol's output is the per-token cross-entropy
+    ([B*L], f32) and no [B*L, vocab] probability tensor is emitted at
+    all — gradients are identical to the parity head (use the default
+    probs head for eval/predict)."""
     b, l, d = batch_size, seq_len, d_model
     net = sym.Embedding(data=sym.Variable("data"), input_dim=vocab_size,
                         output_dim=d, name="embed")
@@ -77,4 +81,5 @@ def transformer_lm(vocab_size=256, num_layers=2, d_model=64, heads=4,
     net = sym.FullyConnected(data=net, num_hidden=vocab_size, name="lm_head")
     label = sym.Reshape(data=sym.Variable("softmax_label"), shape=(b * l,))
     return sym.SoftmaxOutput(data=net, label=label, name="softmax",
-                             out_dtype="same" if head_same_dtype else "")
+                             out_dtype="same" if head_same_dtype else "",
+                             out_mode="loss" if loss_head else "")

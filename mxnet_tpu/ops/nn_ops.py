@@ -890,7 +890,8 @@ def _softmax_rows(x):
 
 
 def _softmax_output_core(data, label, grad_scale, ignore_label, multi_output,
-                         use_ignore, normalization, out_dtype=""):
+                         use_ignore, normalization, out_dtype="",
+                         out_mode=""):
     # loss heads compute in >=f32 regardless of the activation dtype (AMP
     # policy: softmax/log in bf16 destroys small probabilities).  The
     # cast happens INSIDE fwd/bwd so the residual keeps the ORIGINAL
@@ -901,6 +902,23 @@ def _softmax_output_core(data, label, grad_scale, ignore_label, multi_output,
     def _fn(data, label):
         in_dtype = data.dtype
         data = _amp_f32(data)
+        if out_mode == "loss":
+            # training head: per-position cross-entropy, label-shaped.
+            # No [N, num_class] probability tensor is ever EMITTED — the
+            # logsumexp fuses into the logits producer, and backward
+            # recomputes softmax from the saved (activation-dtype)
+            # logits.  Reference analog: make_loss-inl.h's loss-value
+            # path over softmax (MakeLoss grad_scale semantics stay on
+            # the GRADIENT, as in SoftmaxOutput).
+            axis = 1 if (multi_output and data.ndim > 2) else -1
+            lse = jax.scipy.special.logsumexp(data, axis=axis)
+            picked = jnp.take_along_axis(
+                data, jnp.expand_dims(label.astype(jnp.int32), axis),
+                axis=axis)
+            nll = lse - jnp.squeeze(picked, axis)
+            if use_ignore:
+                nll = nll * (label != ignore_label).astype(nll.dtype)
+            return nll
         if multi_output and data.ndim > 2:
             prob = jax.nn.softmax(data, axis=1)
         else:
@@ -960,7 +978,8 @@ def _softmax_output_shape(params, in_shapes):
             shapes[1] = (d[0],) + tuple(d[2:])
         else:
             shapes[1] = (d[0],)
-        out = tuple(d)
+        # loss mode emits per-position NLL (label-shaped), not probs
+        out = shapes[1] if params.get("out_mode") == "loss" else tuple(d)
     else:
         out = None
     return shapes, [out], []
@@ -978,6 +997,13 @@ _SOFTMAX_OUT_PARAMS = {
                          doc="'same' emits probabilities in the input "
                              "dtype (halves the head-output HBM under "
                              "bf16 AMP; compute stays f32)"),
+    "out_mode": OpParam("out_mode", "str", default="",
+                        enum=("", "loss"),
+                        doc="'loss' emits per-position cross-entropy "
+                            "(label-shaped) instead of the [N, C] "
+                            "probabilities; gradients are identical. "
+                            "Training-side lever: nothing [N, C]-sized "
+                            "leaves the head (make_loss-inl.h analog)"),
 }
 
 for _name in ("SoftmaxOutput", "Softmax"):  # "Softmax" is the deprecated alias
@@ -986,7 +1012,8 @@ for _name in ("SoftmaxOutput", "Softmax"):  # "Softmax" is the deprecated alias
         forward=lambda ctx, params, data, label: _softmax_output_core(
             data, label, params["grad_scale"], params["ignore_label"],
             params["multi_output"], params["use_ignore"],
-            params["normalization"], params["out_dtype"]),
+            params["normalization"], params["out_dtype"],
+            params["out_mode"]),
         arguments=("data", "label"),
         params=dict(_SOFTMAX_OUT_PARAMS),
         infer_shape=_softmax_output_shape,

@@ -850,8 +850,13 @@ class _AsyncMetric:
                 self._dev_sum = _acc_fold1(carry, pred, lv)
                 self._dev_num += int(np.prod(lv.shape))
             return
-        self._buf.append(([np.asarray(l.asnumpy() if isinstance(l, NDArray)
-                                      else l) for l in labels], list(outs)))
+        # keep labels as device references too — converting here would be
+        # a device->host sync per batch, defeating the deferred-drain
+        # design.  Snapshot NDArray wrappers to their immutable jax
+        # buffer so later in-place writes can't alias the buffered batch.
+        self._buf.append((
+            [l.data if isinstance(l, NDArray) else np.asarray(l)
+             for l in labels], list(outs)))
         if len(self._buf) >= self._period:
             self._drain()
 
@@ -864,6 +869,6 @@ class _AsyncMetric:
                 self._dev_num = 0
             return
         for labels, outs in self._buf:
-            self.inner.update(labels, [NDArray(np.asarray(o))
-                                       for o in outs])
+            self.inner.update([np.asarray(l) for l in labels],
+                              [NDArray(np.asarray(o)) for o in outs])
         self._buf.clear()

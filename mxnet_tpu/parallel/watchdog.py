@@ -20,7 +20,15 @@ Protocol (one TCP connection per peer to the rank-0 monitor):
 * each peer's listener thread receives the abort and calls
   ``on_failure(dead_rank)`` — default: log loudly, then ``os._exit(70)``
   after a short grace so cleanup hooks (launchers' pkill sweeps, job
-  managers) observe a crashed process instead of a hang.
+  managers) observe a crashed process instead of a hang;
+* the monitor itself is a protected peer, not a blind spot: losing the
+  monitor connection starts a reconnect window (``timeout`` seconds),
+  and if rank 0 never comes back the peer declares **rank 0** dead and
+  fires ``on_failure(0)`` — otherwise the monitor's death would leave
+  every survivor unprotected exactly when the next collective involving
+  rank 0 is guaranteed to hang.  Orderly shutdown is not a false
+  positive source as long as peers ``stop()`` within the reconnect
+  window of rank 0 (stop() silences the peer loop before it can fire).
 """
 from __future__ import annotations
 
@@ -121,31 +129,45 @@ class Watchdog:
                 except OSError:
                     return
                 conn.settimeout(self.timeout)
-                hdr = self._recv_exact(conn, len(_MAGIC) + 4)
+                try:
+                    hdr = self._recv_exact(conn, len(_MAGIC) + 4)
+                except OSError:
+                    hdr = None
                 if hdr is None or hdr[:len(_MAGIC)] != _MAGIC:
                     conn.close()
                     continue
                 (peer,) = struct.unpack("<i", hdr[len(_MAGIC):])
                 with self._mon_lock:
+                    old = self._conns.get(peer)
                     self._conns[peer] = conn
                     self._last_seen[peer] = time.monotonic()
+                if old is not None:  # peer re-registered (transient TCP
+                    try:             # loss): retire the stale connection
+                        old.close()
+                    except OSError:
+                        pass
                 t = threading.Thread(target=beat_loop, args=(peer, conn),
                                      daemon=True)
                 t.start()
 
         def beat_loop(peer, conn):
+            # death is declared by HEARTBEAT SILENCE (stale_loop), not by
+            # connection loss: a dropped TCP connection may be a transient
+            # reset with the peer re-registering within the grace window.
+            # A truly dead peer stops beating, so last_seen ages past
+            # `timeout` and stale_loop fires either way.
             while not self._stop.is_set():
                 try:
                     b = conn.recv(1)
-                except (socket.timeout, OSError):
-                    b = b""
-                if self._stop.is_set():
+                except socket.timeout:
+                    continue
+                except OSError:
                     return
-                if not b:
-                    self._declare_dead(peer)
+                if self._stop.is_set() or not b:
                     return
                 with self._mon_lock:
-                    self._last_seen[peer] = time.monotonic()
+                    if self._conns.get(peer) is conn:
+                        self._last_seen[peer] = time.monotonic()
 
         def stale_loop():
             while not self._stop.is_set():
@@ -185,37 +207,44 @@ class Watchdog:
     # peer side (all ranks, incl. 0's own connection to itself)
     # ------------------------------------------------------------------
 
-    def _start_peer(self) -> None:
-        deadline = time.monotonic() + max(10.0, self.timeout)
-        sock = None
-        while time.monotonic() < deadline:
+    def _connect(self, window: float):
+        """Dial the monitor, retrying for ``window`` seconds; None if it
+        never answers."""
+        deadline = time.monotonic() + window
+        while time.monotonic() < deadline and not self._stop.is_set():
             try:
                 sock = socket.create_connection(self.monitor_addr,
                                                 timeout=2.0)
-                break
+                sock.sendall(_MAGIC + struct.pack("<i", self.rank))
+                sock.settimeout(self.interval)
+                return sock
             except OSError:
                 time.sleep(0.2)
+        return None
+
+    def _start_peer(self) -> None:
+        sock = self._connect(max(10.0, self.timeout))
         if sock is None:
             raise OSError(f"watchdog: cannot reach monitor at "
                           f"{self.monitor_addr}")
-        sock.sendall(_MAGIC + struct.pack("<i", self.rank))
-        sock.settimeout(self.interval)
         self._sock = sock
 
-        def peer_loop():
+        def serve(conn):
+            """Beat/listen on one monitor connection until it drops
+            ('lost') or an abort arrives ('done')."""
             last_beat = 0.0
             while not self._stop.is_set():
                 now = time.monotonic()
                 if now - last_beat >= self.interval:
                     try:
-                        sock.sendall(b".")
+                        conn.sendall(b".")
                     except OSError:
-                        return
+                        return "lost"
                     last_beat = now
                 try:
-                    data = self._recv_exact(sock, len(_MAGIC) + 5)
-                except OSError:
-                    return
+                    data = self._recv_exact(conn, len(_MAGIC) + 5)
+                except OSError:  # incl. ConnectionError on EOF
+                    return "lost"
                 if data is None:
                     continue
                 if (data[:len(_MAGIC)] == _MAGIC
@@ -224,7 +253,30 @@ class Watchdog:
                     if not self._stop.is_set():
                         self._stop.set()
                         self.on_failure(dead)
+                    return "done"
+            return "done"
+
+        def peer_loop():
+            conn = sock
+            while not self._stop.is_set():
+                if serve(conn) == "done" or self._stop.is_set():
                     return
+                # monitor connection lost: rank 0 may be restarting its
+                # socket or may be dead.  Rank 0's own loopback peer needs
+                # no guard (monitor death == own death); everyone else
+                # gets a reconnect grace, then declares rank 0 failed.
+                if self.rank == 0:
+                    return
+                conn = self._connect(self.timeout)
+                if conn is None:
+                    if not self._stop.is_set():
+                        self._stop.set()
+                        log.error(
+                            "watchdog: monitor (rank 0) unreachable for "
+                            "%.1fs — declaring rank 0 dead", self.timeout)
+                        self.on_failure(0)
+                    return
+                self._sock = conn
 
         t = threading.Thread(target=peer_loop, daemon=True)
         t.start()
@@ -232,6 +284,10 @@ class Watchdog:
 
     @staticmethod
     def _recv_exact(conn, n):
+        """Read exactly n bytes.  Returns None on a quiet timeout (no
+        bytes buffered yet), keeps buffering across timeouts once a
+        message has started, and raises ConnectionError on EOF so a
+        closed socket is a signal, not a silent drop or busy-spin."""
         buf = b""
         while len(buf) < n:
             try:
@@ -241,6 +297,7 @@ class Watchdog:
                     continue
                 return None
             if not chunk:
-                return None if not buf else None
+                raise ConnectionError("watchdog: connection closed"
+                                      + (" mid-message" if buf else ""))
             buf += chunk
         return buf

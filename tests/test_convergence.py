@@ -58,8 +58,11 @@ def _check_or_record(name, losses):
     assert g.shape == l.shape, (g.shape, l.shape)
     # pointwise trajectory match (tolerates fp scheduling noise, fails
     # on real regressions: a 2x-too-strong weight decay or a broken BN
-    # momentum shifts the curve far beyond this band)
-    np.testing.assert_allclose(l, g, rtol=0.10, atol=0.05,
+    # momentum shifts the curve far beyond this band).  r5: tightened
+    # from rtol 0.10/atol 0.05 now that BOTH goldens are cross-anchored
+    # against independent plain-JAX twins (systematic drift a loose band
+    # would bless gets caught by the twin tests regardless)
+    np.testing.assert_allclose(l, g, rtol=0.05, atol=0.02,
                                err_msg=f"trajectory diverged from {name}")
     # and the run must actually learn as much as the golden did
     assert l[-1] < 0.6 * l[0] + 0.05, (l[0], l[-1])
@@ -114,12 +117,7 @@ def _init_args(sym, input_shapes, seed):
     return out
 
 
-def test_resnet8_loss_trajectory():
-    b, size, classes = 32, 24, 4
-    sym = models.get_symbol("resnet-28-small", num_classes=classes, n=1)
-    shapes = {"data": (b, 3, size, size), "softmax_label": (b,)}
-    args = _init_args(sym, shapes, seed=11)
-    X, Y = _grating_images(b * 32, size=size, classes=classes, seed=3)
+def _framework_resnet8_losses(sym, shapes, args, X, Y, b):
     t = ShardedTrainer(sym, optimizer="sgd",
                        optimizer_params={"learning_rate": 0.02,
                                          "momentum": 0.9},
@@ -136,7 +134,132 @@ def test_resnet8_loss_trajectory():
         if step % EVERY == 0:
             losses.append(_ce_from_probs(out[0],
                                          batch["softmax_label"]))
+    return losses
+
+
+def _resnet8_setup():
+    b, size, classes = 32, 24, 4
+    sym = models.get_symbol("resnet-28-small", num_classes=classes, n=1)
+    shapes = {"data": (b, 3, size, size), "softmax_label": (b,)}
+    args = _init_args(sym, shapes, seed=11)
+    X, Y = _grating_images(b * 32, size=size, classes=classes, seed=3)
+    return sym, shapes, args, X, Y, b, classes
+
+
+def test_resnet8_loss_trajectory():
+    sym, shapes, args, X, Y, b, _ = _resnet8_setup()
+    losses = _framework_resnet8_losses(sym, shapes, args, X, Y, b)
     _check_or_record("convergence_resnet8.json", losses)
+
+
+def _twin_resnet8_losses(args, X, Y, b):
+    """Plain-JAX reimplementation of resnet-28-small(n=1) + SGD training —
+    shares NOTHING with mxnet_tpu but the initial params and data
+    (VERDICT r5 item 7: the absolute-correctness anchor for the CNN
+    stack; the transformer twin below is the LM-side analog).
+
+    Architecture mirror (models/resnet.py resnet_cifar, n=1):
+    conv0/bn0/relu stem; unit1 16ch s1 identity-shortcut (conv1,conv2);
+    unit2 32ch s2 conv-shortcut (conv3,conv4,conv5=1x1 proj);
+    unit3 64ch s2 conv-shortcut (conv6,conv7,conv8=1x1 proj);
+    global mean pool -> fc1 -> softmax CE.  BatchNorm matches
+    batch_norm-inl.h semantics as implemented in ops/nn_ops.py: biased
+    single-pass variance clamped at 0, eps 1e-3, batch stats in
+    training, grads flow through the statistics.
+    """
+    p0 = {k: jnp.asarray(v) for k, v in args.items()}
+    # symbol auto-naming counters are process-global: convolutionN here
+    # starts wherever earlier tests left it.  Order is build order, so
+    # sort by the numeric suffix and address layers positionally.
+    def _ordered(prefix, suffix):
+        names = [n for n in args if n.startswith(prefix)
+                 and n.endswith(suffix)]
+        return sorted(names, key=lambda n: int(
+            n[len(prefix):-len(suffix)]))
+    conv_w = _ordered("convolution", "_weight")
+    bn_g = _ordered("batchnorm", "_gamma")
+    bn_b = _ordered("batchnorm", "_beta")
+    assert len(conv_w) == 9 and len(bn_g) == 9, (conv_w, bn_g)
+
+    def conv(x, w, stride, pad):
+        return jax.lax.conv_general_dilated(
+            x, w, window_strides=(stride, stride),
+            padding=[(pad, pad), (pad, pad)],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+
+    def bn(x, g, bb):
+        mean = jnp.mean(x, axis=(0, 2, 3))
+        var = jnp.maximum(
+            jnp.mean(jnp.square(x), axis=(0, 2, 3)) - jnp.square(mean), 0.0)
+        inv = jax.lax.rsqrt(var + 1e-3)
+        scale = (g * inv).reshape(1, -1, 1, 1)
+        shift = (bb - mean * g * inv).reshape(1, -1, 1, 1)
+        return x * scale + shift
+
+    def brc(p, x, i, stride, pad, relu=True):
+        y = bn(conv(x, p[conv_w[i]], stride, pad),
+               p[bn_g[i]], p[bn_b[i]])
+        return jax.nn.relu(y) if relu else y
+
+    def forward(p, x):
+        x = brc(p, x, 0, 1, 1)
+        # unit 1: 16ch, identity shortcut
+        body = brc(p, x, 1, 1, 1)
+        body = brc(p, body, 2, 1, 1, relu=False)
+        x = jax.nn.relu(body + x)
+        # units 2, 3: stride-2, 1x1 projection shortcut
+        for i0, in_s in ((3, 2), (6, 2)):
+            body = brc(p, x, i0, in_s, 1)
+            body = brc(p, body, i0 + 1, 1, 1, relu=False)
+            short = brc(p, x, i0 + 2, in_s, 0, relu=False)
+            x = jax.nn.relu(body + short)
+        feat = jnp.mean(x, axis=(2, 3))            # global avg pool
+        return feat @ p["fc1_weight"].T + p["fc1_bias"]
+
+    def loss_fn(p, x, labels):
+        logits = forward(p, x)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -logp[jnp.arange(b), labels]
+        # SoftmaxOutput backward is (prob - onehot); the trainer
+        # rescales grads by 1/batch -> objective = sum-CE / b
+        return jnp.sum(nll) / b, jnp.mean(nll)
+
+    grad_fn = jax.jit(jax.value_and_grad(loss_fn, has_aux=True))
+
+    @jax.jit
+    def sgd(p, mom, g, lr, momentum):
+        new_p, new_m = {}, {}
+        for k in p:
+            m2 = momentum * mom[k] - lr * g[k]
+            new_p[k] = p[k] + m2
+            new_m[k] = m2
+        return new_p, new_m
+
+    p = dict(p0)
+    mom = {k: jnp.zeros_like(v) for k, v in p.items()}
+    losses = []
+    for step in range(STEPS):
+        k = step % 32
+        x = jnp.asarray(X[k * b:(k + 1) * b])
+        labels = jnp.asarray(Y[k * b:(k + 1) * b].astype(np.int32))
+        (_, mean_nll), g = grad_fn(p, x, labels)
+        if step % EVERY == 0:
+            losses.append(float(mean_nll))
+        p, mom = sgd(p, mom, g, 0.02, 0.9)
+    return losses
+
+
+def test_resnet8_matches_plain_jax_twin():
+    """The CNN golden is validated against an independent hand-rolled
+    implementation, not just against its own recording — a conv/BN/
+    shortcut/optimizer bug baked into the golden would diverge here."""
+    sym, shapes, args, X, Y, b, _ = _resnet8_setup()
+    fw = np.asarray(_framework_resnet8_losses(sym, shapes, args, X, Y, b))
+    tw = np.asarray(_twin_resnet8_losses(args, X, Y, b))
+    np.testing.assert_allclose(fw[:15], tw[:15], rtol=5e-3, atol=5e-3,
+                               err_msg="framework diverged from the "
+                               "hand-rolled plain-JAX conv twin")
+    np.testing.assert_allclose(fw[15:], tw[15:], rtol=0.25, atol=0.05)
 
 
 # ---------------------------------------------------------------------------

@@ -64,9 +64,16 @@ def make_timer(op, primary, rest):
 
     def chain(n, primary, *rest):
         def body(i, acc):
-            scale = (1.0 + 1e-12 * i).astype(primary.dtype)
+            # 1..8, exactly representable in bf16: the scale must CHANGE
+            # the operand's value or XLA hoists the op out of the loop
+            # (1 + 1e-12 rounds to 1.0 in bf16 -> one conv for any n).
+            # The accumulator must consume the WHOLE output: reducing a
+            # single element lets the simplifier push the slice through
+            # the conv and compute one dot product per "conv" (observed:
+            # 17,000 "TFLOP/s").  The sum fuses into the conv epilogue.
+            scale = (1 + i % 8).astype(primary.dtype)
             out = op(primary * scale, *rest)
-            return acc + out.ravel()[0].astype(jnp.float32)
+            return acc + jnp.sum(out.astype(jnp.float32))
         return jax.lax.fori_loop(0, n, body, jnp.float32(0.0))
 
     fn = jax.jit(chain)
@@ -99,8 +106,11 @@ def conv_fwd(s, p):
     return op
 
 
-def variants_for(name, cin, hw, cout, k, s, p, batch, rng):
-    """Yield (variant_name, op, primary, rest, flops_per_call)."""
+def variants_for(name, cin, hw, cout, k, s, p, batch, rng, check=False):
+    """Yield (variant_name, op, primary, rest, flops_per_call).
+
+    ``check=True`` additionally asserts each replacement variant matches
+    the XLA-VJP reference on the live data before it is timed."""
     import jax
     import jax.numpy as jnp
     ho = (hw + 2 * p - k) // s + 1
@@ -111,23 +121,39 @@ def variants_for(name, cin, hw, cout, k, s, p, batch, rng):
     macs = batch * ho * ho * cout * cin * k * k
     fl = 2.0 * macs
 
+    def _assert_close(vname, got, ref):
+        got = np.asarray(got, np.float32)
+        ref = np.asarray(ref, np.float32)
+        err = float(np.max(np.abs(got - ref)))
+        tol = 1e-2 * max(1.0, float(np.max(np.abs(ref))))
+        print(json.dumps({"shape": name, "variant": vname,
+                          "check_max_err": round(err, 6),
+                          "check_ok": err <= tol}), flush=True)
+        if err > tol:
+            raise AssertionError(f"{name}/{vname} mismatch: {err}")
+
     yield "fwd", fwd, x, (w,), fl
 
-    def dgrad(dy_, w_):
-        _, vjp = jax.vjp(lambda xx: fwd(xx, w_), x)
+    # all arrays are explicit args — a closure-captured operand becomes a
+    # baked-in constant at trace time (hundreds of MB through the tunnel)
+    def dgrad(dy_, w_, x_):
+        _, vjp = jax.vjp(lambda xx: fwd(xx, w_), x_)
         return vjp(dy_)[0]
-    yield "dgrad", dgrad, dy, (w,), fl
+    yield "dgrad", dgrad, dy, (w, x), fl
 
-    def wgrad(x_, dy_):
-        _, vjp = jax.vjp(lambda ww: fwd(x_, ww), w)
+    def wgrad(x_, dy_, w_):
+        _, vjp = jax.vjp(lambda ww: fwd(x_, ww), w_)
         return vjp(dy_)[0]
-    yield "wgrad", wgrad, x, (dy,), fl
+    yield "wgrad", wgrad, x, (dy, w), fl
 
     if s == 2:
         # phase-decomposed dgrad: dx split by output parity, 4 stride-1
         # convs over the kernel-tap parity classes, interleaved back.
         def dgrad_phase(dy_, w_):
             return _phase_dgrad(dy_, w_, (batch, cin, hw, hw), k, s, p)
+        if check:
+            _assert_close("dgrad_phase", dgrad_phase(dy, w),
+                          dgrad(dy, w, x))
         yield "dgrad_phase", dgrad_phase, dy, (w,), fl
 
     if k == 1 and s == 1:
@@ -138,6 +164,8 @@ def variants_for(name, cin, hw, cout, k, s, p, batch, rng):
                 dym, xm, (((0, 2), (0, 2)), ((), ())),
                 preferred_element_type=jnp.float32)
             return out.reshape(cout, cin, 1, 1)
+        if check:
+            _assert_close("wgrad_mm", wgrad_mm(x, dy), wgrad(x, dy, w))
         yield "wgrad_mm", wgrad_mm, x, (dy,), fl
 
 
@@ -169,7 +197,6 @@ def _phase_dgrad(dy, w, x_shape, k, s, p):
             # dx[h] with h = s*i + a pulls dy[(h+p-u)/s] = dy[i + (a+p-u0)/s - j]
             off = (a + p - u0) // s
             lo = off - (ku - 1)
-            h_out = -(-hh + a) // s if a < hh else 0  # ceil((hh - a)/s)
             h_out = (hh - 1 - a) // s + 1
             w_out = (ww_ - 1 - b) // s + 1
             offb = (b + p - v0) // s
@@ -208,7 +235,7 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--filter", default="")
     ap.add_argument("--batch", type=int, default=256)
-    ap.add_argument("--iters", type=int, nargs=2, default=(4, 12))
+    ap.add_argument("--iters", type=int, nargs=2, default=(16, 80))
     ap.add_argument("--check", action="store_true",
                     help="numerically check variants vs XLA on CPU-size data")
     args = ap.parse_args()
@@ -222,7 +249,8 @@ def main():
             continue
         best = {}
         for vname, op, primary, rest, fl in variants_for(
-                name, cin, hw, cout, k, s, p, args.batch, rng):
+                name, cin, hw, cout, k, s, p, args.batch, rng,
+                check=args.check):
             t = slope(make_timer(op, primary, rest), *args.iters)
             eff = fl / t / 1e12
             rows.append({"shape": name, "variant": vname,
