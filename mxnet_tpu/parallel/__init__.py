@@ -26,6 +26,7 @@ from .ring_attention import local_attention, ring_attention, ring_self_attention
 from .moe import load_balance_loss, moe_ffn, moe_ffn_ep, switch_ffn
 from .pipeline import pipeline_apply
 from .pipeline_trainer import PipelineTrainer
+from .pipeline_spmd import SpmdPipelineTrainer
 
 __all__ = [
     "Mesh", "NamedSharding", "PartitionSpec",
@@ -36,5 +37,5 @@ __all__ = [
     "ShardedTrainer", "ShardingRules", "megatron_rules",
     "ring_attention", "ring_self_attention", "local_attention",
     "switch_ffn", "moe_ffn", "moe_ffn_ep", "load_balance_loss", "pipeline_apply",
-    "PipelineTrainer",
+    "PipelineTrainer", "SpmdPipelineTrainer",
 ]

@@ -464,15 +464,19 @@ class PipelineTrainer:
     # Step
     # ------------------------------------------------------------------
 
-    def _split_micro(self, batch) -> List[List[Dict[str, jax.Array]]]:
-        """Per-stage, per-microbatch input dicts placed on stage devices."""
+    def _named_inputs(self, batch) -> Dict[str, Any]:
+        """Normalize a DataBatch / dict / positional batch to a name->
+        array dict in ``self._input_names`` order."""
         if hasattr(batch, "data"):
             vals = list(batch.data) + list(batch.label or [])
-            named = dict(zip(self._input_names, vals))
-        elif isinstance(batch, dict):
-            named = batch
-        else:
-            named = dict(zip(self._input_names, batch))
+            return dict(zip(self._input_names, vals))
+        if isinstance(batch, dict):
+            return batch
+        return dict(zip(self._input_names, batch))
+
+    def _split_micro(self, batch) -> List[List[Dict[str, jax.Array]]]:
+        """Per-stage, per-microbatch input dicts placed on stage devices."""
+        named = self._named_inputs(batch)
         M = self.num_microbatches
         out = []
         for s in range(self.num_stages):
