@@ -598,7 +598,9 @@ class SpmdPipelineTrainer(PipelineTrainer):
         for n in self._input_names:
             v = named[n]
             v = v.data if hasattr(v, "data") else v
-            v = np.asarray(v, np.float32)
+            if not isinstance(v, jax.Array):
+                v = np.asarray(v, np.float32)  # host input: one H2D put
+            v = v.astype(jnp.float32) if v.dtype != np.float32 else v
             out[n] = v.reshape((M, v.shape[0] // M) + v.shape[1:])
         return out
 

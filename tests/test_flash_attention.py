@@ -133,3 +133,59 @@ def test_flash_cross_attention_interpret(lq, lk):
     for a, b, n in zip(gf, gd, "qkv"):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=2e-4, atol=2e-4, err_msg=f"d{n}")
+
+
+def test_flash_under_dp_tp_mesh_uses_shard_map():
+    """Advisor r4 medium: inside a GSPMD dp/tp-sharded step the pallas
+    kernel must run per-shard under shard_map (XLA cannot partition an
+    opaque custom call), and the result must stay exact."""
+    from mxnet_tpu.parallel import make_mesh
+    from mxnet_tpu.parallel.mesh import default_mesh
+
+    rng = np.random.RandomState(0)
+    b, h, l, d = 4, 4, 128, 32
+    q, k, v = (jnp.asarray(rng.randn(b, h, l, d).astype(np.float32)) * 0.3
+               for _ in range(3))
+    mesh = make_mesh({"data": 2, "model": 2}, jax.devices()[:4])
+    with default_mesh(mesh):
+        # the wrap decision happens at trace time with the mesh active
+        out = flash_attention(q, k, v, causal=True, block_q=64, block_k=64,
+                              interpret=True)
+        jaxpr = jax.make_jaxpr(
+            lambda q, k, v: flash_attention(q, k, v, causal=True,
+                                            block_q=64, block_k=64,
+                                            interpret=True))(q, k, v)
+    assert "shard_map" in str(jaxpr), \
+        "pallas path not wrapped in shard_map under a dp/tp mesh"
+    ref = local_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_flash_under_manual_region_not_double_wrapped():
+    """Inside an existing shard_map region the operands carry varying
+    manual axes — the GSPMD wrap must not re-enter shard_map."""
+    import functools
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+    from mxnet_tpu.parallel import make_mesh
+    from mxnet_tpu.parallel.mesh import default_mesh
+
+    rng = np.random.RandomState(1)
+    b, h, l, d = 2, 2, 128, 32
+    q, k, v = (jnp.asarray(rng.randn(b, h, l, d).astype(np.float32)) * 0.3
+               for _ in range(3))
+    mesh = make_mesh({"data": 2}, jax.devices()[:2])
+    spec = P("data", None, None, None)
+
+    def body(q, k, v):
+        return flash_attention(q, k, v, causal=True, block_q=64,
+                               block_k=64, interpret=True)
+
+    with default_mesh(mesh):
+        fn = shard_map(body, mesh=mesh, in_specs=(spec,) * 3,
+                       out_specs=spec)
+        out = jax.jit(fn)(q, k, v)
+    ref = local_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)

@@ -64,15 +64,19 @@ def make_timer(op, primary, rest):
 
     def chain(n, primary, *rest):
         def body(i, acc):
-            # 1..8, exactly representable in bf16: the scale must CHANGE
-            # the operand's value or XLA hoists the op out of the loop
-            # (1 + 1e-12 rounds to 1.0 in bf16 -> one conv for any n).
+            # The per-iteration transform must make the op input a
+            # DIFFERENT tensor each step in a way XLA cannot factor out.
+            # A scalar multiply is NOT enough: conv/dot are linear in the
+            # primary operand, so conv(x*s_i) = s_i*conv(x) and the
+            # simplifier hoists the conv (observed: rows at 385-2155
+            # "TFLOP/s", far above the chip's 197 peak).  abs(x + i) is
+            # not scalar-related across iterations, so the op must run.
             # The accumulator must consume the WHOLE output: reducing a
             # single element lets the simplifier push the slice through
             # the conv and compute one dot product per "conv" (observed:
             # 17,000 "TFLOP/s").  The sum fuses into the conv epilogue.
-            scale = (1 + i % 8).astype(primary.dtype)
-            out = op(primary * scale, *rest)
+            shift = (1 + i % 8).astype(primary.dtype)
+            out = op(jnp.abs(primary + shift), *rest)
             return acc + jnp.sum(out.astype(jnp.float32))
         return jax.lax.fori_loop(0, n, body, jnp.float32(0.0))
 
@@ -168,6 +172,19 @@ def variants_for(name, cin, hw, cout, k, s, p, batch, rng, check=False):
             _assert_close("wgrad_mm", wgrad_mm(x, dy), wgrad(x, dy, w))
         yield "wgrad_mm", wgrad_mm, x, (dy,), fl
 
+        # 1x1 dgrad as a plain matmul: dx[n,c,h,w] = sum_o dy[n,o,h,w]
+        # * w[o,c] — XLA's transposed-conv lowering leaves several of
+        # these at 30-40 TF; a dot_general should run near peak
+        def dgrad_mm(dy_, w_):
+            w2 = w_.reshape(cout, cin)
+            out = jax.lax.dot_general(
+                dy_, w2, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)  # [n, h, w, c]
+            return out.transpose(0, 3, 1, 2).astype(dy_.dtype)
+        if check:
+            _assert_close("dgrad_mm", dgrad_mm(dy, w), dgrad(dy, w, x))
+        yield "dgrad_mm", dgrad_mm, dy, (w,), fl
+
 
 def _phase_dgrad(dy, w, x_shape, k, s, p):
     """dx for a stride-s conv via s*s phase convolutions (no zero insert).
@@ -256,8 +273,12 @@ def main():
             rows.append({"shape": name, "variant": vname,
                          "ms": round(t * 1e3, 3),
                          "tflops": round(eff, 1), "count": count})
+            suspect = eff > 210  # v5e bf16 peak is 197: reading is bogus
+            if suspect:
+                rows[-1]["suspect_hoisted"] = True
             print(json.dumps(rows[-1]), flush=True)
-            best.setdefault(vname.split("_")[0], []).append((t, vname))
+            if not suspect:  # hoisted timings must not win best/totals
+                best.setdefault(vname.split("_")[0], []).append((t, vname))
         for base in ("fwd", "dgrad", "wgrad"):
             if base in best:
                 total[base] += count * min(best[base])[0]
