@@ -1,0 +1,215 @@
+"""Hand-rolled conv backward paths for shapes where XLA's lowering is slow.
+
+The 2016 reference ships device-tuned conv backward implementations
+(``src/operator/cudnn_convolution-inl.h`` — cuDNN picks dgrad/wgrad
+algorithms per shape).  Here conv backward is whatever XLA emits for the
+``conv_general_dilated`` transpose, and the r4 trace analysis
+(``docs/perf.md``) showed that is the ResNet-50 MFU blocker: several
+backward lowerings run at 30-60 TF on a 197 TF chip.  This module gives
+:func:`conv2d` a ``custom_vjp`` that swaps in restructured backward
+computations per static shape — measured per ResNet-50 shape on the
+real chip by ``tools/conv_probe.py`` — and keeps XLA's own transpose
+for every shape where XLA already wins:
+
+* ``dgrad_mm`` — 1x1 stride-1 input gradient as a plain ``dot_general``
+  over the channel dim (XLA's transposed-conv lowering leaves some of
+  these at 33-40 TF; the MXU runs the equivalent GEMM near peak);
+* ``wgrad_mm`` — 1x1 stride-1 weight gradient as a batched GEMM over
+  N*H*W;
+* ``phase_dgrad`` — stride-2 input gradient decomposed into s*s
+  STRIDE-1 convolutions over kernel-tap parity classes (XLA's
+  ``lhs_dilation`` transpose inserts zeros, wasting 3/4 of the MXU MACs
+  at stride 2), interleaved back into the output phases.
+
+All variants are exact restructurings (same arithmetic, different
+schedule); ``tests/test_conv_backward.py`` pins them against XLA's own
+VJP and finite differences.  ``MXNET_TPU_CONV_BWD=xla`` disables the
+dispatch wholesale.
+"""
+from __future__ import annotations
+
+import functools
+import os
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["conv2d"]
+
+
+# ---------------------------------------------------------------------------
+# variant implementations
+# ---------------------------------------------------------------------------
+
+def _plain_conv(x, w, stride, pad):
+    return jax.lax.conv_general_dilated(
+        x, w, window_strides=stride,
+        padding=[(pad[0], pad[0]), (pad[1], pad[1])],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+
+
+def _dgrad_mm(dy, w, x_shape):
+    """1x1 stride-1: dx[n,c,h,w] = sum_o dy[n,o,h,w] * w[o,c]."""
+    cout, cin = w.shape[0], w.shape[1]
+    w2 = w.reshape(cout, cin)
+    out = jax.lax.dot_general(
+        dy, w2, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)              # [n, h, w, c]
+    return out.transpose(0, 3, 1, 2).astype(dy.dtype)
+
+
+def _wgrad_mm(x, dy, w_shape):
+    """1x1 stride-1: dw[o,c] = sum_{n,h,w} dy[n,o,h,w] * x[n,c,h,w]."""
+    n, cin, hh, ww = x.shape
+    cout = dy.shape[1]
+    xm = x.reshape(n, cin, hh * ww)
+    dym = dy.reshape(n, cout, hh * ww)
+    out = jax.lax.dot_general(
+        dym, xm, (((0, 2), (0, 2)), ((), ())),
+        preferred_element_type=jnp.float32)
+    return out.reshape(w_shape).astype(dy.dtype)
+
+
+def _phase_dgrad(dy, w, x_shape, k, s, p):
+    """dx for a stride-s conv via s*s phase convolutions (no zero
+    insertion): group kernel taps by (u % s, t % s); each parity class
+    contributes one output phase as a STRIDE-1 conv of dy with the
+    flipped tap subset; phases interleave back into dx."""
+    n, c, hh, ww_ = x_shape
+    phases = []
+    for a in range(s):
+        row = []
+        for b in range(s):
+            u0 = (a + p) % s
+            v0 = (b + p) % s
+            wk = w[:, :, u0::s, v0::s]                   # (O, C, ku, kv)
+            ku, kv = wk.shape[2], wk.shape[3]
+            if ku == 0 or kv == 0:
+                row.append(None)                         # phase gets no taps
+                continue
+            wk = jnp.flip(wk, (2, 3)).transpose(1, 0, 2, 3)
+            off = (a + p - u0) // s
+            lo = off - (ku - 1)
+            h_out = (hh - 1 - a) // s + 1
+            w_out = (ww_ - 1 - b) // s + 1
+            offb = (b + p - v0) // s
+            lob = offb - (kv - 1)
+            dyh, dyw = dy.shape[2], dy.shape[3]
+            pad_lo = -lo if lo < 0 else 0
+            crop_lo = lo if lo > 0 else 0
+            pad_hi = max(0, (h_out - 1) + off - (dyh - 1))
+            pad_lob = -lob if lob < 0 else 0
+            crop_lob = lob if lob > 0 else 0
+            pad_hib = max(0, (w_out - 1) + offb - (dyw - 1))
+            ph = jax.lax.conv_general_dilated(
+                dy, wk, window_strides=(1, 1),
+                padding=[(pad_lo, pad_hi), (pad_lob, pad_hib)],
+                dimension_numbers=("NCHW", "OIHW", "NCHW"))
+            ph = ph[:, :, crop_lo:crop_lo + h_out, crop_lob:crop_lob + w_out]
+            row.append(ph)
+        phases.append(row)
+    h_max = max(ph.shape[2] for row in phases for ph in row if ph is not None)
+    w_max = max(ph.shape[3] for row in phases for ph in row if ph is not None)
+    stacked = jnp.zeros((n, c, h_max, s, w_max, s), dy.dtype)
+    for a in range(s):
+        for b in range(s):
+            ph = phases[a][b]
+            if ph is None:
+                continue
+            stacked = stacked.at[:, :, :ph.shape[2], a, :ph.shape[3], b].set(ph)
+    return stacked.reshape(n, c, h_max * s, w_max * s)[:, :, :hh, :ww_]
+
+
+# ---------------------------------------------------------------------------
+# per-shape dispatch policy (measured on TPU v5e, tools/conv_probe.py)
+# ---------------------------------------------------------------------------
+
+def _use_dgrad_mm(k, s, p, cin, cout, hw):
+    # the matmul form assumes output spatial == input spatial
+    return k == 1 and s == 1 and p == 0
+
+
+def _use_wgrad_mm(k, s, p, cin, cout, hw):
+    return k == 1 and s == 1 and p == 0
+
+
+def _use_phase_dgrad(k, s, p, cin, cout, hw):
+    return s > 1
+
+
+def _policy(x_shape, w_shape, stride, pad):
+    """Returns (dgrad_kind, wgrad_kind) for this static shape."""
+    if os.environ.get("MXNET_TPU_CONV_BWD", "") == "xla":
+        return "xla", "xla"
+    n, cin, hh, _ = x_shape
+    cout, _, kh, kw = w_shape
+    s, p = stride[0], pad[0]
+    # the tuned variants assume square kernel/stride and SYMMETRIC pad
+    # (the phase decomposition applies p to both spatial dims)
+    if kh != kw or stride[0] != stride[1] or pad[0] != pad[1]:
+        return "xla", "xla"
+    dgrad = "xla"
+    if _use_dgrad_mm(kh, s, p, cin, cout, hh):
+        dgrad = "mm"
+    elif _use_phase_dgrad(kh, s, p, cin, cout, hh):
+        dgrad = "phase"
+    wgrad = "mm" if _use_wgrad_mm(kh, s, p, cin, cout, hh) else "xla"
+    return dgrad, wgrad
+
+
+# ---------------------------------------------------------------------------
+# custom-vjp conv
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _conv2d_cv(x, w, stride, pad):
+    return _plain_conv(x, w, stride, pad)
+
+
+def _conv2d_fwd(x, w, stride, pad):
+    return _plain_conv(x, w, stride, pad), (x, w)
+
+
+def _conv2d_bwd(stride, pad, res, dy):
+    x, w = res
+    dgrad_kind, wgrad_kind = _policy(x.shape, w.shape, stride, pad)
+    kh = w.shape[2]
+    s, p = stride[0], pad[0]
+
+    # one-sided XLA fallbacks: never build the transpose we replaced
+    # (under jit DCE would drop it, but eager/debug paths run for real)
+    if dgrad_kind == "mm":
+        dx = _dgrad_mm(dy, w, x.shape)
+    elif dgrad_kind == "phase":
+        dx = _phase_dgrad(dy, w, x.shape, kh, s, p)
+    else:
+        _, vjp_x = jax.vjp(lambda xx: _plain_conv(xx, w, stride, pad), x)
+        dx = vjp_x(dy)[0]
+    if wgrad_kind == "mm":
+        dw = _wgrad_mm(x, dy, w.shape)
+    else:
+        _, vjp_w = jax.vjp(lambda ww: _plain_conv(x, ww, stride, pad), w)
+        dw = vjp_w(dy)[0]
+    return dx, dw
+
+
+_conv2d_cv.defvjp(_conv2d_fwd, _conv2d_bwd)
+
+
+def conv2d(x, w, *, stride, pad, dilate=(1, 1), groups=1):
+    """NCHW/OIHW conv with per-shape tuned backward (see module doc).
+
+    Falls through to the plain XLA path (plain VJP included) for
+    grouped or dilated convs — the tuned variants cover the standard
+    ResNet/Inception families.
+    """
+    if groups != 1 or tuple(dilate) != (1, 1):
+        return jax.lax.conv_general_dilated(
+            x, w, window_strides=tuple(stride),
+            padding=[(pad[0], pad[0]), (pad[1], pad[1])],
+            rhs_dilation=tuple(dilate),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            feature_group_count=groups)
+    return _conv2d_cv(x, w, tuple(stride), tuple(pad))

@@ -184,6 +184,7 @@ register_op(OpDef(
 # ---------------------------------------------------------------------------
 
 def _conv_fwd(ctx, params, data, weight, bias=None):
+    from .conv_backward import conv2d
     stride = _pair(params["stride"])
     dilate = _pair(params["dilate"])
     pad = _pair(params["pad"])
@@ -191,14 +192,10 @@ def _conv_fwd(ctx, params, data, weight, bias=None):
     # accumulates in f32 internally either way
     if data.dtype != weight.dtype:
         data = data.astype(weight.dtype)
-    out = jax.lax.conv_general_dilated(
-        data, weight,
-        window_strides=stride,
-        padding=[(pad[0], pad[0]), (pad[1], pad[1])],
-        rhs_dilation=dilate,
-        dimension_numbers=("NCHW", "OIHW", "NCHW"),
-        feature_group_count=params["num_group"],
-    )
+    # conv2d carries per-shape tuned backward paths (conv_backward.py)
+    # — the analog of the reference's cuDNN dgrad/wgrad algorithm picks
+    out = conv2d(data, weight, stride=stride, pad=pad, dilate=dilate,
+                 groups=params["num_group"])
     if bias is not None:
         out = out + bias.astype(out.dtype).reshape(1, -1, 1, 1)
     return out

@@ -291,3 +291,30 @@ def test_spmd_eval_forward():
     np.testing.assert_allclose(np.asarray(spmd.forward(b)[0]),
                                np.asarray(host.forward(b)[0]),
                                rtol=2e-5, atol=2e-6)
+
+
+def test_spmd_amp_trains():
+    """compute_dtype='bfloat16' through the compiled schedule: params
+    stay f32 masters in the flat buffers, activations flow bf16 over
+    the f32 wire, and the model still trains."""
+    import mxnet_tpu as mx
+    mx.random.seed(11)
+    net = _mlp4(widths=(32, 24, 16, 4))
+    spmd = SpmdPipelineTrainer(net, num_stages=4, num_microbatches=2,
+                               optimizer="sgd",
+                               optimizer_params={"learning_rate": 0.5,
+                                                 "momentum": 0.9},
+                               compute_dtype="bfloat16")
+    spmd.bind(data_shapes={"data": (16, 16)},
+              label_shapes={"softmax_label": (16,)})
+    rng = np.random.RandomState(4)
+    proto = rng.randn(4, 16).astype(np.float32) * 2
+    acc = []
+    for _ in range(40):
+        y = rng.randint(0, 4, 16)
+        x = proto[y] + rng.randn(16, 16).astype(np.float32) * 0.3
+        out = spmd.step({"data": x, "softmax_label": y.astype(np.float32)})
+        acc.append(float((np.asarray(out[0]).argmax(1) == y).mean()))
+    assert np.mean(acc[-5:]) > 0.9, acc[-5:]
+    import jax.numpy as jnp
+    assert spmd._pflat.dtype == jnp.float32

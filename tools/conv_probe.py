@@ -15,18 +15,25 @@ from cuDNN, src/operator/cudnn_convolution-inl.h):
   zeros, wasting 3/4 of the MXU MACs at stride 2);
 * ``wgrad_mm``    — 1x1 wgrad as a plain dot_general over N*H*W.
 
-Timing: chained ``fori_loop`` with an iteration-dependent input scale
-(prevents hoisting; the scalar multiply fuses into the conv), one
+Timing: chained ``fori_loop`` with a NON-FACTORABLE per-iteration input
+transform (``abs(x + i)``) and a NONLINEAR whole-output accumulator
+(``sum(abs(out))``) — conv is linear in its input, so scalar scales
+hoist and plain sums collapse through it (see make_timer).  One
 device->host scalar fetch at the end, two-point slope over loop counts
-to cancel the tunnel round-trip (docs/perf.md).
+sized so the delta is ~120 ms of device time (tunnel jitter is +-3-5 ms
+on a ~97 ms RTT; see iters_for).
 
-Usage: python tools/conv_probe.py [--filter 3x3_s2] [--iters 4 12]
+Usage: python tools/conv_probe.py [--filter 3x3_s2] [--iters 64 400]
 """
 import argparse
 import json
+import os
+import sys
 import time
 
 import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 # (name, cin, hw_in, cout, k, stride, pad, count_in_resnet50)
 RESNET50_SHAPES = [
@@ -71,13 +78,16 @@ def make_timer(op, primary, rest):
             # simplifier hoists the conv (observed: rows at 385-2155
             # "TFLOP/s", far above the chip's 197 peak).  abs(x + i) is
             # not scalar-related across iterations, so the op must run.
-            # The accumulator must consume the WHOLE output: reducing a
-            # single element lets the simplifier push the slice through
-            # the conv and compute one dot product per "conv" (observed:
-            # 17,000 "TFLOP/s").  The sum fuses into the conv epilogue.
+            # The accumulator must consume the WHOLE output NONLINEARLY:
+            # a plain sum lets the simplifier push the reduction through
+            # the (linear) conv — sum(conv(x, w)) collapses to an
+            # elementwise dot with precomputed kernel sums (observed:
+            # 5,515 "TFLOP/s") — and reducing a single element pushes a
+            # slice through the same way.  abs blocks the rewrite; it
+            # still fuses into the conv epilogue.
             shift = (1 + i % 8).astype(primary.dtype)
             out = op(jnp.abs(primary + shift), *rest)
-            return acc + jnp.sum(out.astype(jnp.float32))
+            return acc + jnp.sum(jnp.abs(out.astype(jnp.float32)))
         return jax.lax.fori_loop(0, n, body, jnp.float32(0.0))
 
     fn = jax.jit(chain)
@@ -89,7 +99,7 @@ def make_timer(op, primary, rest):
     return t_of_n
 
 
-def slope(t_of_n, n1, n2, reps=3):
+def slope(t_of_n, n1, n2, reps=5):
     """Median two-point slope in seconds per op."""
     t_of_n(n1)  # compile+warm
     out = []
@@ -99,6 +109,18 @@ def slope(t_of_n, n1, n2, reps=3):
         out.append((t2 - t1) / (n2 - n1))
     ok = sorted(s for s in out if s > 0)
     return ok[(len(ok) - 1) // 2] if ok else float("nan")
+
+
+def iters_for(flops, target_s=0.12, rate=150e12, floor_s=15e-6):
+    """Iteration counts sized so the SLOPE SIGNAL dominates tunnel
+    jitter: the ~97 ms RTT carries +-3-5 ms of noise, so the n2-n1
+    delta must represent >= ~120 ms of device time.  A fixed small
+    count made every sub-0.3 ms row pure noise (observed: 'ops' at
+    963 TF on a 197 TF chip, negative slopes, 5x run-to-run flips)."""
+    per_op = max(flops / rate, floor_s)
+    delta = int(np.ceil(target_s / per_op))
+    n1 = max(8, delta // 4)
+    return n1, n1 + delta
 
 
 def conv_fwd(s, p):
@@ -150,6 +172,12 @@ def variants_for(name, cin, hw, cout, k, s, p, batch, rng, check=False):
         return vjp(dy_)[0]
     yield "wgrad", wgrad, x, (dy, w), fl
 
+    # candidate replacements are the PRODUCTION implementations
+    # (mxnet_tpu/ops/conv_backward.py) — the probe must time exactly
+    # what ships, so there is one copy of the math
+    from mxnet_tpu.ops.conv_backward import (_dgrad_mm, _phase_dgrad,
+                                             _wgrad_mm)
+
     if s == 2:
         # phase-decomposed dgrad: dx split by output parity, 4 stride-1
         # convs over the kernel-tap parity classes, interleaved back.
@@ -160,99 +188,30 @@ def variants_for(name, cin, hw, cout, k, s, p, batch, rng, check=False):
                           dgrad(dy, w, x))
         yield "dgrad_phase", dgrad_phase, dy, (w,), fl
 
-    if k == 1 and s == 1:
+    if k == 1 and s == 1 and p == 0:
         def wgrad_mm(x_, dy_):
-            xm = x_.reshape(batch, cin, hw * hw)
-            dym = dy_.reshape(batch, cout, hw * hw)
-            out = jax.lax.dot_general(
-                dym, xm, (((0, 2), (0, 2)), ((), ())),
-                preferred_element_type=jnp.float32)
-            return out.reshape(cout, cin, 1, 1)
+            return _wgrad_mm(x_, dy_, (cout, cin, 1, 1))
         if check:
             _assert_close("wgrad_mm", wgrad_mm(x, dy), wgrad(x, dy, w))
         yield "wgrad_mm", wgrad_mm, x, (dy,), fl
 
         # 1x1 dgrad as a plain matmul: dx[n,c,h,w] = sum_o dy[n,o,h,w]
         # * w[o,c] — XLA's transposed-conv lowering leaves several of
-        # these at 30-40 TF; a dot_general should run near peak
+        # these slow; a dot_general should run near peak
         def dgrad_mm(dy_, w_):
-            w2 = w_.reshape(cout, cin)
-            out = jax.lax.dot_general(
-                dy_, w2, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)  # [n, h, w, c]
-            return out.transpose(0, 3, 1, 2).astype(dy_.dtype)
+            return _dgrad_mm(dy_, w_, (batch, cin, hw, hw))
         if check:
             _assert_close("dgrad_mm", dgrad_mm(dy, w), dgrad(dy, w, x))
         yield "dgrad_mm", dgrad_mm, dy, (w,), fl
-
-
-def _phase_dgrad(dy, w, x_shape, k, s, p):
-    """dx for a stride-s conv via s*s phase convolutions (no zero insert).
-
-    dx[n,c,h,v] = sum_{o,u,t} dy[n,o,(h+p-u)/s,(v+p-t)/s] * w[o,c,u,t]
-    restricted to (h+p-u) % s == 0.  Group kernel taps by (u%s, t%s): each
-    parity class contributes to one output phase as a STRIDE-1 conv of dy
-    with the flipped tap subset.
-    """
-    import jax
-    import jax.numpy as jnp
-    n, c, hh, ww_ = x_shape
-    phases = []
-    for a in range(s):
-        row = []
-        for b in range(s):
-            # output positions h = a (mod s): taps u with (a+p-u)%s==0
-            u0 = (a + p) % s
-            v0 = (b + p) % s
-            wk = w[:, :, u0::s, v0::s]  # (O, C, ku, kv)
-            ku, kv = wk.shape[2], wk.shape[3]
-            if ku == 0 or kv == 0:
-                row.append(None)  # no taps reach this phase: dx == 0
-                continue
-            # flip spatially + swap I/O -> conv of dy producing dx phase
-            wk = jnp.flip(wk, (2, 3)).transpose(1, 0, 2, 3)  # (C, O, ku, kv)
-            # dx[h] with h = s*i + a pulls dy[(h+p-u)/s] = dy[i + (a+p-u0)/s - j]
-            off = (a + p - u0) // s
-            lo = off - (ku - 1)
-            h_out = (hh - 1 - a) // s + 1
-            w_out = (ww_ - 1 - b) // s + 1
-            offb = (b + p - v0) // s
-            lob = offb - (kv - 1)
-            dyh = dy.shape[2]
-            # padding so that conv output length == h_out with start index lo
-            pad_lo = -lo if lo < 0 else 0
-            crop_lo = lo if lo > 0 else 0
-            hi_need = (h_out - 1) + off  # last dy index touched
-            pad_hi = max(0, hi_need - (dyh - 1))
-            pad_lob = -lob if lob < 0 else 0
-            crop_lob = lob if lob > 0 else 0
-            hib_need = (w_out - 1) + offb
-            pad_hib = max(0, hib_need - (dy.shape[3] - 1))
-            ph = jax.lax.conv_general_dilated(
-                dy, wk, window_strides=(1, 1),
-                padding=[(pad_lo, pad_hi), (pad_lob, pad_hib)],
-                dimension_numbers=("NCHW", "OIHW", "NCHW"))
-            ph = ph[:, :, crop_lo:crop_lo + h_out, crop_lob:crop_lob + w_out]
-            row.append(ph)
-        phases.append(row)
-    # interleave: dx[:, :, s*i+a, s*j+b] = phases[a][b][:, :, i, j]
-    h_max = max(ph.shape[2] for row in phases for ph in row if ph is not None)
-    w_max = max(ph.shape[3] for row in phases for ph in row if ph is not None)
-    stacked = jnp.zeros((n, c, h_max, s, w_max, s), dy.dtype)
-    for a in range(s):
-        for b in range(s):
-            ph = phases[a][b]
-            if ph is None:
-                continue
-            stacked = stacked.at[:, :, :ph.shape[2], a, :ph.shape[3], b].set(ph)
-    return stacked.reshape(n, c, h_max * s, w_max * s)[:, :, :hh, :ww_]
 
 
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--filter", default="")
     ap.add_argument("--batch", type=int, default=256)
-    ap.add_argument("--iters", type=int, nargs=2, default=(16, 80))
+    ap.add_argument("--iters", type=int, nargs=2, default=None,
+                    help="fixed (n1, n2); default: auto-sized per shape "
+                    "so the slope signal is ~120 ms of device time")
     ap.add_argument("--check", action="store_true",
                     help="numerically check variants vs XLA on CPU-size data")
     args = ap.parse_args()
@@ -268,7 +227,8 @@ def main():
         for vname, op, primary, rest, fl in variants_for(
                 name, cin, hw, cout, k, s, p, args.batch, rng,
                 check=args.check):
-            t = slope(make_timer(op, primary, rest), *args.iters)
+            n1, n2 = args.iters if args.iters else iters_for(fl)
+            t = slope(make_timer(op, primary, rest), n1, n2)
             eff = fl / t / 1e12
             rows.append({"shape": name, "variant": vname,
                          "ms": round(t * 1e3, 3),
