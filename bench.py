@@ -67,10 +67,11 @@ def _fetch(h):
     return np.asarray(h[(0,) * h.ndim]) if h.ndim else np.asarray(h)
 
 
-def measure(trainer, feeds, steps):
+def measure(trainer, feeds, steps, with_flops=True):
     """Slope timing: warmup+compile, then N and 3N step runs each closed
     by a forced fetch.  Returns (per_step_s, dispatch_s, compile_s,
-    flops_per_step)."""
+    flops_per_step).  ``with_flops=False`` skips the cost-model twin
+    (bench_lm computes its own dense-attention twin instead)."""
     t0 = time.perf_counter()
     heads = trainer.step(feeds[0])
     _fetch(heads[0])
@@ -109,7 +110,7 @@ def measure(trainer, feeds, steps):
     dispatch = (time.perf_counter() - t0) / steps
     _fetch(trainer.step(feeds[0])[0])  # drain
 
-    flops = _step_flops(trainer, feeds[0])
+    flops = _step_flops(trainer, feeds[0]) if with_flops else None
     return per_step, dispatch, compile_s, flops
 
 
@@ -124,21 +125,30 @@ def _lowered_flops(trainer, placed):
     return float(ca["flops"])
 
 
-def _step_flops(trainer, placed):
+def _step_flops(trainer, placed, flops_symbol=None):
     """XLA cost-model FLOPs of one full train step (fwd+bwd+update).
 
     Some backends (the axon tunnel) return no cost analysis from their
     lowering; fall back to an identical single-CPU-device twin of the
-    step, whose algorithmic FLOPs are the same."""
-    try:
-        return _lowered_flops(trainer, placed)
-    except Exception:
-        pass
+    step, whose algorithmic FLOPs are the same.
+
+    ``flops_symbol`` (optional) replaces the twin's symbol — bench_lm
+    passes a DENSE-attention twin so the count is convention-stable:
+    XLA's cost model is trip-count-blind inside ``scan`` bodies and
+    opaque for Pallas kernels, so counting the flash program directly
+    would change with every block-size policy.  The dense twin counts
+    full QK^T/PV einsums — the standard dense-equivalent MFU
+    convention (no causal discount)."""
+    if flops_symbol is None:
+        try:
+            return _lowered_flops(trainer, placed)
+        except Exception:
+            pass
     try:
         import jax
         from mxnet_tpu.parallel import ShardedTrainer, make_mesh
         twin = ShardedTrainer(
-            trainer.symbol,
+            flops_symbol or trainer.symbol,
             mesh=make_mesh({"data": 1}, [jax.devices("cpu")[0]]),
             optimizer=type(trainer.optimizer).__name__.lower(),
             optimizer_params={"learning_rate": 0.1},
@@ -237,11 +247,14 @@ def bench_lm(args):
 
     b, l = args.batch_size, args.seq_len
     vocab = args.vocab
-    sym = models.get_symbol(
-        "transformer-lm", vocab_size=vocab, num_layers=args.num_layers,
+    # ONE kwargs dict builds both the timed symbol and the dense
+    # FLOPs twin — they must be the same model up to attn_block_size
+    lm_kwargs = dict(
+        vocab_size=vocab, num_layers=args.num_layers,
         d_model=args.d_model, heads=max(1, args.d_model // 64),
         batch_size=b, seq_len=l, remat=args.remat,
         head_same_dtype=args.head_bf16, loss_head=args.head_loss)
+    sym = models.get_symbol("transformer-lm", **lm_kwargs)
     trainer = _make_trainer(sym, args.precision, args.compute_dtype,
                             optimizer="adam",
                             optimizer_params={"learning_rate": 1e-3})
@@ -252,22 +265,16 @@ def bench_lm(args):
         {"data": rng.randint(0, vocab, (b, l)).astype(np.float32),
          "softmax_label": rng.randint(0, vocab, (b, l)).astype(np.float32)})
         for _ in range(2)]
-    per_step, dispatch, compile_s, flops = measure(trainer, feeds, args.steps)
-    from mxnet_tpu.parallel.flash_attention import (AUTO_SWITCH_LEN,
-                                                    _pick_block)
-    # matches the op's auto-switch: only blockwise/flash-served lengths
-    # need the analytic attention term (dense einsums ARE cost-counted)
-    if (flops is not None and l >= AUTO_SWITCH_LEN
-            and _pick_block(l) is not None):
-        # blockwise/flash regime: XLA's cost model counts neither scan
-        # bodies (documented in docs/perf.md) nor Pallas kernels, so add
-        # the attention train FLOPs analytically — fwd per layer is
-        # QK^T + PV = 4*B*L^2*d_model flops x0.5 causal = 2*B*L^2*d
-        # (head count cancels: H * (d/H) = d), and the flash backward
-        # recomputes scores in both the dq and dk/dv kernels (7
-        # block-matmuls vs the forward's 2), so train total = 4.5x fwd
-        att_fwd = 2.0 * b * l * l * args.d_model
-        flops += args.num_layers * 4.5 * att_fwd
+    # MFU accounting: flops come from a DENSE-attention twin of the
+    # same model (attn_block_size=-1) — the dense-equivalent convention
+    # (full QK^T/PV einsums, no causal discount), stable across kernel
+    # block policies.  Counting the flash program itself is impossible
+    # (scan bodies are trip-count-blind, Pallas kernels opaque).
+    dense_sym = models.get_symbol("transformer-lm", attn_block_size=-1,
+                                  **lm_kwargs)
+    per_step, dispatch, compile_s, _ = measure(trainer, feeds, args.steps,
+                                               with_flops=False)
+    flops = _step_flops(trainer, feeds[0], flops_symbol=dense_sym)
     tok_s = b * l / per_step
     prec = args.compute_dtype or args.precision
     return report(

@@ -28,20 +28,28 @@ def _layernorm(x, name):
     return sym.LayerNorm(data=x, name=name)
 
 
-def transformer_block(x, b, l, d, heads, name, causal=True):
+def transformer_block(x, b, l, d, heads, name, causal=True,
+                      attn_block_size=0):
     hd = d // heads
 
+    # heads stay at dim 2 ([B, L, H, hd] — the natural post-projection
+    # layout): RingAttention(layout='blhd') consumes it directly.  The
+    # graph carries no SwapAxis; the remaining head transposes live
+    # inside the attention wrapper (the current Mosaic lowering cannot
+    # slice per-head blocks out of an (H, d)-tiled ref, so real-TPU
+    # runs still transpose to the [BH, L, D] kernel — the H-looped
+    # native-layout kernels are written, interpret-verified, and switch
+    # on when Mosaic supports them; see flash_attention.py)
     def split_heads(t):
-        t = sym.Reshape(data=t, shape=(b, l, heads, hd))
-        return sym.SwapAxis(data=t, dim1=1, dim2=2)      # [B, H, L, hd]
+        return sym.Reshape(data=t, shape=(b, l, heads, hd))
 
     h = _layernorm(x, f"{name}_ln1")
     q = split_heads(_linear(h, b, l, d, d, f"{name}_q"))
     k = split_heads(_linear(h, b, l, d, d, f"{name}_k"))
     v = split_heads(_linear(h, b, l, d, d, f"{name}_v"))
     att = sym.RingAttention(query=q, key=k, value=v, causal=causal,
+                            block_size=attn_block_size, layout="blhd",
                             name=f"{name}_attn")
-    att = sym.SwapAxis(data=att, dim1=1, dim2=2)
     att = sym.Reshape(data=att, shape=(b, l, d))
     att = _linear(att, b, l, d, d, f"{name}_proj")
     x = x + att
@@ -54,7 +62,8 @@ def transformer_block(x, b, l, d, heads, name, causal=True):
 
 def transformer_lm(vocab_size=256, num_layers=2, d_model=64, heads=4,
                    batch_size=8, seq_len=64, causal=True, remat=False,
-                   head_same_dtype=False, loss_head=False):
+                   head_same_dtype=False, loss_head=False,
+                   attn_block_size=0):
     """Build the LM symbol; inputs ``data``/``softmax_label`` are
     ``[batch, seq]`` token ids.  ``remat=True`` wraps each block in a
     ``remat_scope`` so backward recomputes the block from its boundary
@@ -75,7 +84,8 @@ def transformer_lm(vocab_size=256, num_layers=2, d_model=64, heads=4,
                  else contextlib.nullcontext())
         with scope:
             net = transformer_block(net, b, l, d, heads, f"layer{i}",
-                                    causal=causal)
+                                    causal=causal,
+                                    attn_block_size=attn_block_size)
     net = _layernorm(net, "final_ln")
     net = sym.Reshape(data=net, shape=(b * l, d))
     net = sym.FullyConnected(data=net, num_hidden=vocab_size, name="lm_head")

@@ -21,28 +21,40 @@ def _attention_fwd(ctx, params, q, k, v):
     from ..parallel.ring_attention import local_attention, ring_self_attention
     causal = params["causal"]
     axis = params["seq_axis"]
+    blhd = params.get("layout", "bhld") == "blhd"
     mesh = current_mesh()
     if (mesh is not None and axis in mesh.axis_names
             and mesh.shape[axis] > 1):
-        return ring_self_attention(q, k, v, mesh, seq_axis=axis,
-                                   causal=causal)
+        # ring attention shards the seq dim at position 2: bring blhd
+        # inputs to [B, H, L, D] around the ring (the transpose cost
+        # only exists on the multi-chip path)
+        if blhd:
+            q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+        out = ring_self_attention(q, k, v, mesh, seq_axis=axis,
+                                  causal=causal)
+        return out.transpose(0, 2, 1, 3) if blhd else out
     # single shard: dense for short sequences, flash (fused Pallas
     # kernel on TPU, jnp blockwise scan on cpu — never materializes the
     # [L, L] scores) past the threshold
     block = params["block_size"]
-    if block == 0:
-        lk = k.shape[2]
+    if block < 0:
+        # block_size=-1 forces the DENSE path (cost-model-countable
+        # einsums; bench.py uses this twin for convention-stable MFU)
+        block = None
+    elif block == 0:
+        lk = k.shape[1] if blhd else k.shape[2]
         # at 1024+ the fused kernel beats dense outright (r4 bench:
         # 257k tok/s @ seq 2048 vs dense 218k @ 1024 on the 6L d512 LM)
         # and dense [L, L] f32 score residuals OOM 16 GB chips at 2048
         from ..parallel.flash_attention import AUTO_SWITCH_LEN, _pick_block
         if lk >= AUTO_SWITCH_LEN:
-            # largest power-of-two block that divides L (shared policy
-            # with the kernel); lengths with no divisor >= 64 fall back
-            # to dense WITH a warning — pad the sequence or pass
+            # past the threshold: the blockwise/flash family with the
+            # kernel's own tuned block picks (block stays 0 = "auto");
+            # lengths with no power-of-two divisor >= 64 fall back to
+            # dense WITH a warning — pad the sequence or pass
             # block_size explicitly to avoid the [L, L] score memory
-            block = _pick_block(lk)
-            if block is None:
+            if _pick_block(lk) is None:
+                block = None
                 import logging
                 logging.getLogger(__name__).warning(
                     "attention seq len %d >= 1024 has no power-of-two "
@@ -51,7 +63,23 @@ def _attention_fwd(ctx, params, q, k, v):
                     lk)
         else:
             block = None
-    return local_attention(q, k, v, causal=causal, block_size=block or None)
+    if blhd:
+        if block is not None:
+            # [B, L, H, D] consumed without a symbol-level SwapAxis.
+            # NOTE: the H-looped native-layout kernels are exact in
+            # interpret mode, but the current Mosaic lowering rejects
+            # per-head slices of an (H, d)-tiled block, so on real TPU
+            # flash_attention transposes to the bhld kernel internally
+            # — same data movement as the old SwapAxis graph, cleaner
+            # symbol; the native path switches on when Mosaic can
+            # lower it (flash_attention.py:pallas_path).
+            from ..parallel.flash_attention import flash_attention
+            return flash_attention(q, k, v, causal=causal, layout="blhd",
+                                   block_k=(block or None))
+        q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+        out = local_attention(q, k, v, causal=causal, block_size=None)
+        return out.transpose(0, 2, 1, 3)
+    return local_attention(q, k, v, causal=causal, block_size=block)
 
 
 def _attention_shape(params, in_shapes):
@@ -62,7 +90,9 @@ def _attention_shape(params, in_shapes):
     if len(known) != 4:
         from ..base import MXNetError
         raise MXNetError(
-            f"RingAttention expects [batch, heads, seq, head_dim], got {known}")
+            f"RingAttention expects [batch, heads, seq, head_dim] (or "
+            f"[batch, seq, heads, head_dim] with layout='blhd'), "
+            f"got {known}")
     return [tuple(known)] * 3, [tuple(q or known)], []
 
 
@@ -143,6 +173,13 @@ register_op(OpDef(
     params={
         "causal": OpParam("causal", "bool", default=False),
         "seq_axis": OpParam("seq_axis", "str", default="seq"),
+        "layout": OpParam("layout", "str", default="bhld",
+                          enum=("bhld", "blhd"),
+                          doc="'blhd' consumes [batch, seq, heads, "
+                              "head_dim] directly (the natural "
+                              "post-projection layout): the flash "
+                              "kernel slices head blocks without any "
+                              "transpose"),
         "block_size": OpParam("block_size", "int", default=0,
                               doc="0 = auto (dense below 1024; fused Pallas "
                                   "flash kernel on TPU / blockwise scan on "

@@ -951,16 +951,31 @@ def _softmax_output_core(data, label, grad_scale, ignore_label, multi_output,
             prob = jax.nn.softmax(data, axis=-1)
             oh = jax.nn.one_hot(label.astype(jnp.int32), data.shape[-1],
                                 dtype=data.dtype)
-            grad = (prob - oh) * grad_scale
-            mask = (label != ignore_label).astype(data.dtype)
+            # cast at the SUBTRACTION, before scaling: (prob - oh) is in
+            # [-1, 1] so the cast is safe, and it keeps the [N, C]
+            # gradient in the activation dtype at the fusion boundary —
+            # under bf16 AMP at an LM head this is the difference
+            # between writing a 2.1 GB f32 and a 1.05 GB bf16 dlogits
+            # tensor per step (traced: 4.7 ms -> memory-bound).  The
+            # optimization barrier pins the boundary: without it XLA
+            # fuses the convert into the consumers and materializes the
+            # PRE-convert f32 tensor (observed in the compiled module)
+            grad = (prob - oh).astype(in_dtype)
+            if grad_scale != 1.0:
+                grad = grad * jnp.asarray(grad_scale, in_dtype)
+            mask = (label != ignore_label).astype(in_dtype)
             if use_ignore:
                 grad = grad * mask[..., None]
+            if grad.dtype != jnp.float32:  # only when the cast narrows
+                grad = jax.lax.optimization_barrier(grad)
         if normalization == "batch":
-            grad = grad / label.shape[0]
+            grad = grad / jnp.asarray(float(label.shape[0]), grad.dtype)
         elif normalization == "valid":
-            denom = jnp.maximum(jnp.sum(mask) if use_ignore
-                                else jnp.asarray(float(label.size)), 1.0)
-            grad = grad / denom
+            # count in f32: a bf16 accumulator cannot count past 256
+            denom = jnp.maximum(
+                jnp.sum(mask.astype(jnp.float32)) if use_ignore
+                else jnp.asarray(float(label.size)), 1.0)
+            grad = grad / denom.astype(grad.dtype)
         return grad.astype(in_dtype), jnp.zeros_like(label)
 
     _fn.defvjp(_fwd, _bwd)

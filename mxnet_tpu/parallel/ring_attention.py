@@ -35,20 +35,25 @@ def local_attention(q, k, v, *, causal=False, scale=None,
     with optional causal masking in GLOBAL positions (offsets give each
     shard its position in the full sequence).
 
-    For long sequences pass ``block_size`` (or leave the default
-    auto-switch in :func:`blockwise_attention`'s caller): the dense path
-    materializes the full ``[L, Lk]`` score matrix.
+    ``block_size``: ``None`` = dense (materializes the full ``[L, Lk]``
+    score matrix); ``0`` = blockwise/flash family with auto-tuned block
+    sizes; ``> 0`` = blockwise/flash with the given K-block size.
     """
     if block_size is not None:
-        from .flash_attention import NEG_INF, flash_attention
+        from .flash_attention import NEG_INF, _pick_block, flash_attention
         if q_offset == 0 and kv_offset == 0 and neg_inf == NEG_INF:
             # fused Pallas kernel on accelerators, jnp scan on cpu.
-            # The kernel hardcodes the default masking value, so a
-            # caller-supplied neg_inf routes to the jnp path (advisor
-            # r4: the fast path must not silently drop the argument).
+            # block_size=0 means "auto": the kernel applies its own
+            # tuned picks (bk=1024 beats 512 by 20-30% measured); an
+            # explicit size is honored — it bounds the blockwise
+            # working set the caller asked for.  The kernel hardcodes
+            # the default masking value, so a caller-supplied neg_inf
+            # routes to the jnp path (advisor r4: the fast path must
+            # not silently drop the argument).
             return flash_attention(q, k, v, causal=causal, scale=scale,
-                                   block_q=None, block_k=block_size)
-        return blockwise_attention(q, k, v, block_size, causal=causal,
+                                   block_k=(block_size or None))
+        blk = block_size or _pick_block(k.shape[2]) or k.shape[2]
+        return blockwise_attention(q, k, v, blk, causal=causal,
                                    scale=scale, q_offset=q_offset,
                                    kv_offset=kv_offset, neg_inf=neg_inf)
     d = q.shape[-1]

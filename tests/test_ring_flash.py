@@ -74,7 +74,7 @@ def test_ring_flash_no_dense_scores_in_hlo():
     ring attention at a shape where block < shard and assert the
     compiled HLO holds no per-shard [lq, lkv] f32 score tensor."""
     sp = 2
-    l, d = 2048, 32                      # shard 1024, flash block 512
+    l, d = 4096, 32                      # shard 2048 > flash block 1024
     lq = l // sp
     q, k, v = _mk(b=1, h=1, l=l, d=d)
     mesh = make_mesh({"seq": sp}, jax.devices()[:sp])
