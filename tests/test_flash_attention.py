@@ -189,3 +189,59 @@ def test_flash_under_manual_region_not_double_wrapped():
     ref = local_attention(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_blhd_layout_interpret_matches_dense(causal):
+    """The native [B, L, H, D] kernels (H-looped grid cells): exact in
+    interpret mode vs the dense reference, fwd and grads.  These switch
+    onto real TPU when Mosaic supports per-head slices of an
+    (H, d)-tiled block — this test keeps them correct until then."""
+    rng = np.random.RandomState(0)
+    b, h, l, d = 2, 4, 256, 32
+    q4, k4, v4 = (jnp.asarray(rng.randn(b, l, h, d).astype(np.float32)) * 0.3
+                  for _ in range(3))
+
+    def t(x):
+        return x.transpose(0, 2, 1, 3)
+
+    out = flash_attention(q4, k4, v4, causal=causal, block_q=64,
+                          block_k=64, interpret=True, layout="blhd")
+    ref = t(local_attention(t(q4), t(k4), t(v4), causal=causal))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+    def loss(q, k, v):
+        return jnp.sum(jnp.tanh(flash_attention(
+            q, k, v, causal=causal, block_q=64, block_k=64,
+            interpret=True, layout="blhd")))
+
+    def loss_ref(q, k, v):
+        return jnp.sum(jnp.tanh(t(local_attention(
+            t(q), t(k), t(v), causal=causal))))
+
+    g = jax.grad(loss, argnums=(0, 1, 2))(q4, k4, v4)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q4, k4, v4)
+    for n, a, b_ in zip("qkv", g, gr):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b_), rtol=3e-4, atol=3e-5,
+            err_msg=f"blhd d{n} mismatch (causal={causal})")
+
+
+def test_flash_blhd_real_path_transposes_to_bhld():
+    """Non-interpret blhd must route through the PROVEN bhld kernel
+    (Mosaic limitation): same trace on both layouts, values equal."""
+    rng = np.random.RandomState(1)
+    b, h, l, d = 2, 2, 128, 32
+    q4, k4, v4 = (jnp.asarray(rng.randn(b, l, h, d).astype(np.float32)) * 0.3
+                  for _ in range(3))
+
+    def t(x):
+        return x.transpose(0, 2, 1, 3)
+
+    out = flash_attention(q4, k4, v4, causal=True, block_q=64, block_k=64,
+                          layout="blhd")
+    ref = t(flash_attention(t(q4), t(k4), t(v4), causal=True, block_q=64,
+                            block_k=64))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)

@@ -119,3 +119,31 @@ def test_ring_plus_blockwise_compose():
     out = ring_self_attention(q, k, v, mesh)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_attention_op_blhd_flash_branch_at_long_seq():
+    """transformer-lm hardcodes RingAttention(layout='blhd'); at
+    seq >= 1024 the op takes the blhd flash branch (auto block).  Pin
+    its numerics against dense attention through the SYMBOL layer."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.graph_eval import eval_symbol
+
+    b, h, l, d = 1, 2, 1024, 32
+    rng = np.random.RandomState(0)
+    args = {n: rng.randn(b, l, h, d).astype(np.float32) * 0.3
+            for n in ("q", "k", "v")}
+
+    def run(block_size):
+        sym = mx.symbol.RingAttention(
+            query=mx.symbol.Variable("q"), key=mx.symbol.Variable("k"),
+            value=mx.symbol.Variable("v"), causal=True, layout="blhd",
+            block_size=block_size, name="att")
+        heads, _ = eval_symbol(
+            sym, {n: jnp.asarray(v) for n, v in args.items()}, {}, None,
+            True)
+        return np.asarray(heads[0])
+
+    flash = run(0)    # auto: blhd flash branch (seq 1024 >= threshold)
+    dense = run(-1)   # forced dense twin path
+    np.testing.assert_allclose(flash, dense, rtol=2e-4, atol=2e-5)
+    assert flash.shape == (b, l, h, d)

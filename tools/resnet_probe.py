@@ -1,0 +1,203 @@
+"""Raw-JAX ResNet-50 train-step probe: the framework-overhead referee.
+
+A from-scratch plain-JAX twin of the framework's ResNet-50 training
+step with MATCHING semantics — bf16 AMP activation flow with f32 master
+params, BatchNorm folded to per-channel scale/shift in the activation
+dtype with f32 moment statistics and EMA aux outputs, softmax
+cross-entropy head, SGD with momentum + weight decay (wd skipped on
+gamma/beta/bias, as the trainer does) — timed with the same two-point
+slope protocol as bench.py.
+
+Purpose (r5): the r4 analysis claimed a ~14 ms/step gap between the
+framework (109.7 ms) and a raw-JAX probe of the same semantics
+(~94-95 ms), attributing it to framework overhead.  That probe was
+never committed; this one is, so the claim is reproducible.  The r5
+trace shows the in-context step is HBM-bandwidth-bound (hot fusions at
+670-850 GB/s on an 819 GB/s chip), which bounds what any framework-side
+change can recover.
+
+Usage: python tools/resnet_probe.py [--batch 256] [--steps 6]
+"""
+import argparse
+import functools
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+BLOCKS = (3, 4, 6, 3)          # ResNet-50 bottleneck counts
+WIDTHS = (64, 128, 256, 512)   # per-stage bottleneck widths
+
+
+def build_params(rng):
+    import jax.numpy as jnp
+    p = {}
+    a = {}
+
+    def conv(name, cin, cout, k):
+        p[name + "_w"] = jnp.asarray(
+            rng.normal(0, np.sqrt(2.0 / (k * k * cin)),
+                       (cout, cin, k, k)).astype(np.float32))
+
+    def bn(name, c):
+        p[name + "_g"] = jnp.ones((c,), jnp.float32)
+        p[name + "_b"] = jnp.zeros((c,), jnp.float32)
+        a[name + "_mean"] = jnp.zeros((c,), jnp.float32)
+        a[name + "_var"] = jnp.ones((c,), jnp.float32)
+
+    conv("stem", 3, 64, 7)
+    bn("stem_bn", 64)
+    cin = 64
+    for s, (n, w) in enumerate(zip(BLOCKS, WIDTHS)):
+        for b in range(n):
+            pre = f"s{s}b{b}"
+            conv(pre + "_c1", cin, w, 1)
+            bn(pre + "_bn1", w)
+            conv(pre + "_c2", w, w, 3)
+            bn(pre + "_bn2", w)
+            conv(pre + "_c3", w, w * 4, 1)
+            bn(pre + "_bn3", w * 4)
+            if b == 0:
+                conv(pre + "_sc", cin, w * 4, 1)
+                bn(pre + "_scbn", w * 4)
+            cin = w * 4
+    p["fc_w"] = jnp.asarray(
+        rng.normal(0, 0.01, (cin, 1000)).astype(np.float32))
+    p["fc_b"] = jnp.zeros((1000,), jnp.float32)
+    return p, a
+
+
+def forward(p16, aux, x, is_train=True, momentum=0.9, eps=1e-5):
+    """bf16 activation flow; BN folded to per-channel scale/shift in the
+    activation dtype with f32 batch moments (the trainer's AMP policy).
+    Returns (per-example CE-ready logits f32, aux updates)."""
+    import jax
+    import jax.numpy as jnp
+
+    new_aux = {}
+
+    def conv(x, name, stride, pad):
+        return jax.lax.conv_general_dilated(
+            x, p16[name + "_w"], (stride, stride), [(pad, pad)] * 2,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+
+    def bnorm(x, name):
+        if is_train:
+            xf = x.astype(jnp.float32)
+            mean = jnp.mean(xf, axis=(0, 2, 3))
+            var = jnp.maximum(
+                jnp.mean(jnp.square(xf), axis=(0, 2, 3)) - mean * mean, 0.0)
+            new_aux[name + "_mean"] = (momentum * aux[name + "_mean"]
+                                       + (1 - momentum) * mean)
+            new_aux[name + "_var"] = (momentum * aux[name + "_var"]
+                                      + (1 - momentum) * var)
+        else:
+            mean, var = aux[name + "_mean"], aux[name + "_var"]
+        scale = (p16[name + "_g"].astype(jnp.float32)
+                 / jnp.sqrt(var + eps))
+        shift = p16[name + "_b"].astype(jnp.float32) - mean * scale
+        scale16 = scale.astype(x.dtype).reshape(1, -1, 1, 1)
+        shift16 = shift.astype(x.dtype).reshape(1, -1, 1, 1)
+        return x * scale16 + shift16
+
+    x = x.astype(jnp.bfloat16)
+    x = jnp.maximum(bnorm(conv(x, "stem", 2, 3), "stem_bn"), 0)
+    x = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, (1, 1, 3, 3),
+                              (1, 1, 2, 2), [(0, 0), (0, 0), (1, 1), (1, 1)])
+    cin = 64
+    for s, (n, w) in enumerate(zip(BLOCKS, WIDTHS)):
+        for b in range(n):
+            pre = f"s{s}b{b}"
+            stride = 2 if (b == 0 and s > 0) else 1
+            sc = x
+            if b == 0:
+                sc = bnorm(conv(x, pre + "_sc", stride, 0), pre + "_scbn")
+            h = jnp.maximum(bnorm(conv(x, pre + "_c1", 1, 0),
+                                  pre + "_bn1"), 0)
+            h = jnp.maximum(bnorm(conv(h, pre + "_c2", stride, 1),
+                                  pre + "_bn2"), 0)
+            h = bnorm(conv(h, pre + "_c3", 1, 0), pre + "_bn3")
+            x = jnp.maximum(h + sc, 0)
+            cin = w * 4
+    x = jnp.mean(x.astype(jnp.float32), axis=(2, 3))
+    logits = x.astype(jnp.bfloat16) @ p16["fc_w"].astype(jnp.bfloat16)
+    return logits.astype(jnp.float32) + p16["fc_b"], new_aux
+
+
+def make_step(lr=0.05, momentum=0.9, wd=1e-4):
+    import jax
+    import jax.numpy as jnp
+
+    def loss_fn(params, aux, x, y):
+        p16 = {k: (v.astype(jnp.bfloat16) if v.ndim == 4 else v)
+               for k, v in params.items()}
+        logits, new_aux = forward(p16, aux, x)
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        picked = jnp.take_along_axis(
+            logits, y[:, None].astype(jnp.int32), 1)[:, 0]
+        return jnp.mean(lse - picked), new_aux
+
+    def step(params, mom, aux, x, y):
+        (loss, new_aux), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params, aux, x, y)
+        new_p, new_m = {}, {}
+        for k in params:
+            g = grads[k].astype(jnp.float32)
+            use_wd = not (k.endswith("_g") or k.endswith("_b"))
+            if use_wd:
+                g = g + wd * params[k]
+            m2 = momentum * mom[k] + g
+            new_m[k] = m2
+            new_p[k] = params[k] - lr * m2
+        aux2 = dict(aux, **new_aux)
+        return new_p, new_m, aux2, loss
+
+    return jax.jit(step, donate_argnums=(0, 1, 2))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--steps", type=int, default=6)
+    args = ap.parse_args()
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(0)
+    params, aux = build_params(rng)
+    mom = {k: jnp.zeros_like(v) for k, v in params.items()}
+    x = jnp.asarray(rng.random((args.batch, 3, 224, 224)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 1000, (args.batch,)), jnp.float32)
+    step = make_step()
+
+    t0 = time.perf_counter()
+    params, mom, aux, loss = step(params, mom, aux, x, y)
+    np.asarray(loss)
+    print(f"compile+first: {time.perf_counter() - t0:.1f}s loss={loss}")
+
+    def run(n):
+        nonlocal params, mom, aux
+        t0 = time.perf_counter()
+        loss = None
+        for _ in range(n):
+            params, mom, aux, loss = step(params, mom, aux, x, y)
+        np.asarray(loss)
+        return time.perf_counter() - t0
+
+    run(3)
+    slopes = []
+    for _ in range(3):
+        t1 = run(args.steps)
+        t2 = run(3 * args.steps)
+        slopes.append((t2 - t1) / (2 * args.steps))
+    ok = sorted(s for s in slopes if s > 0)
+    per = ok[(len(ok) - 1) // 2]
+    print(f"raw-JAX resnet50 twin: {per*1e3:.2f} ms/step "
+          f"({args.batch/per:.0f} img/s)")
+
+
+if __name__ == "__main__":
+    main()
