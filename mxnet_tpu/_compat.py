@@ -1,0 +1,66 @@
+"""Version-compat shims over the installed jax.
+
+The codebase targets the modern spellings (``jax.shard_map``,
+``jax.enable_x64(flag)``); older jax releases (<= 0.4.x) only ship them
+under ``jax.experimental``.  Import from here instead of feature-testing
+at every call site.
+"""
+from __future__ import annotations
+
+import jax
+
+__all__ = ["shard_map", "enable_x64", "platform_dependent",
+           "pallas_tpu_compiler_params"]
+
+# ---------------------------------------------------------------------------
+# shard_map: top-level since jax 0.6, jax.experimental before that.
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:  # pragma: no cover - exercised only on old jax
+    from jax.experimental.shard_map import shard_map  # type: ignore
+
+
+def platform_dependent(*args, default=None, **platform_branches):
+    """``jax.lax.platform_dependent`` that actually prunes branches on old
+    jax.
+
+    Modern jax folds away non-matching branches when the lowering platform
+    is known; 0.4.x lowers every branch (so a Pallas TPU branch blows up
+    when lowering for cpu).  On old jax, select the branch at trace time
+    from the default backend instead — correct for single-backend
+    processes, which is every launch mode this codebase has.
+    """
+    if jax.__version_info__ >= (0, 5, 0):
+        return jax.lax.platform_dependent(*args, default=default,
+                                          **platform_branches)
+    fn = platform_branches.get(jax.default_backend(), default)
+    if fn is None:
+        raise NotImplementedError(
+            f"no branch for platform {jax.default_backend()!r}")
+    return fn(*args)
+
+
+def pallas_tpu_compiler_params(**kwargs):
+    """Build a Pallas TPU compiler-params struct under either name.
+
+    jax >= 0.5 calls it ``pltpu.CompilerParams``; 0.4.x shipped it as
+    ``pltpu.TPUCompilerParams`` (and before that a plain dict worked).
+    """
+    from jax.experimental.pallas import tpu as pltpu
+    cls = getattr(pltpu, "CompilerParams", None) \
+        or getattr(pltpu, "TPUCompilerParams", None)
+    if cls is None:  # pragma: no cover - ancient jax took raw dicts
+        return dict(kwargs)
+    return cls(**kwargs)
+
+
+def enable_x64(flag: bool = True):
+    """Context manager forcing x64 on/off, portable across jax versions.
+
+    Modern jax: ``jax.enable_x64(flag)``.  Older jax only has the
+    ``jax.experimental.enable_x64``/``disable_x64`` pair.
+    """
+    if hasattr(jax, "enable_x64"):
+        return jax.enable_x64(flag)
+    from jax import experimental as _exp
+    return _exp.enable_x64() if flag else _exp.disable_x64()

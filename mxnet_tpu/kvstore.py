@@ -46,9 +46,22 @@ def _value_list(key, value):
 
 
 class KVStore:
-    """Local single-process store (reference ``KVStoreLocal``)."""
+    """Local single-process store (reference ``KVStoreLocal``).
 
-    def __init__(self, kind: str = "local"):
+    ``push`` is deferred: pushed groups accumulate in a priority queue and
+    are reduced through fused flat buckets (``parallel.collectives``) —
+    eagerly once ~``bucket_bytes`` of gradients are pending (so early
+    buckets reduce while later layers are still producing gradients, the
+    overlap the reference gets from its dependency engine), and fully on
+    ``pull``/``barrier``.  ``compression='int8'|'bf16'`` selects a
+    quantized wire format for the reduce; off by default.
+    """
+
+    def __init__(self, kind: str = "local",
+                 compression: Optional[str] = None,
+                 bucket_bytes: Optional[int] = None):
+        from .parallel.collectives import (DEFAULT_BUCKET_BYTES,
+                                           check_compression)
         self._kind = kind
         self._store: Dict[Any, NDArray] = {}
         # per-key merge buffer for the no-updater (allreduce) mode —
@@ -58,6 +71,16 @@ class KVStore:
         self._merge_buf: Dict[Any, NDArray] = {}
         self._updater: Optional[Callable] = None
         self._optimizer_blob: Optional[bytes] = None
+        self._compression = check_compression(compression)
+        self._bucket_bytes = int(bucket_bytes) if bucket_bytes \
+            else DEFAULT_BUCKET_BYTES
+        # deferred pushes: (priority, key, [jax arrays]) in push order
+        self._pending: List = []
+        self._pending_bytes = 0
+
+    @property
+    def compression(self) -> Optional[str]:
+        return self._compression
 
     @property
     def type(self) -> str:
@@ -82,23 +105,46 @@ class KVStore:
             self._store[k] = v.copy()
 
     def push(self, key, value, priority: int = 0) -> None:
-        """Aggregate values into the store; run updater if set
-        (reference ``kvstore_local.h:67-101``)."""
-        import jax
+        """Enqueue values for aggregation; the actual reduce runs through
+        fused buckets (reference ``kvstore_local.h:67-101`` semantics,
+        TPU-native comm path).  Values are snapshotted at push time (jax
+        arrays are immutable), so later in-place caller mutation can't
+        leak into the merge."""
         keys, values = _value_list(key, value)
         for k, vgroup in zip(keys, values):
             if k not in self._store:
                 raise MXNetError(f"kvstore: key {k} not initialized")
-            if len(vgroup) > 1:
-                # device-resident all-reduce over ICI (shard_map psum) —
-                # replaces the reference's GPU→pinned-CPU copies +
-                # ReduceSumCPU funnel (kvstore_local.h:148-236); falls back
-                # to an on-device tree sum when shards are co-resident
-                from .parallel.collectives import allreduce_sum
-                reduced = allreduce_sum([v.data for v in vgroup])
-                merged_val = reduced[0]
-            else:
-                merged_val = vgroup[0].data
+            datas = [v.data for v in vgroup]
+            self._pending.append((priority, k, datas))
+            self._pending_bytes += int(datas[0].size) * datas[0].dtype.itemsize
+            if self._pending_bytes >= self._bucket_bytes:
+                # a bucket's worth is ready — dispatch now (async) so its
+                # reduce overlaps with whatever produces the next pushes
+                self._flush()
+
+    def _flush(self) -> None:
+        """Reduce all pending pushes (bucketed, priority-ordered) and apply
+        updater / merge buffers in original push order."""
+        if not self._pending:
+            return
+        import jax
+        from .parallel.collectives import allreduce_sum
+        pending, self._pending, self._pending_bytes = self._pending, [], 0
+        multi = [i for i, (_, _, datas) in enumerate(pending)
+                 if len(datas) > 1]
+        merged_by_i = {}
+        if multi:
+            # one bucketed reduce over every multi-device group; groups
+            # with co-resident shards fall back internally to a tree sum
+            reduced = allreduce_sum(
+                [pending[i][2] for i in multi],
+                priorities=[pending[i][0] for i in multi],
+                bucket_bytes=self._bucket_bytes,
+                compression=self._compression)
+            for i, r in zip(multi, reduced):
+                merged_by_i[i] = r[0]
+        for i, (_, k, datas) in enumerate(pending):
+            merged_val = merged_by_i.get(i, datas[0])
             dev = self._store[k].context.jax_device
             merged_nd = NDArray(jax.device_put(merged_val, dev),
                                 ctx=self._store[k].context)
@@ -108,6 +154,7 @@ class KVStore:
                 self._merge_buf[k] = merged_nd
 
     def pull(self, key, out=None, priority: int = 0) -> None:
+        self._flush()
         keys, outs = _value_list(key, out)
         for k, ogroup in zip(keys, outs):
             if k not in self._store:
@@ -134,7 +181,7 @@ class KVStore:
         self.set_updater(get_updater(optimizer))
 
     def barrier(self) -> None:
-        pass
+        self._flush()
 
     def send_command_to_servers(self, head: int, body: str) -> None:
         pass
@@ -144,6 +191,7 @@ class KVStore:
         momentum/Adam moments must survive a save/load cycle."""
         if self._optimizer_blob is None:
             raise MXNetError("no optimizer set on kvstore")
+        self._flush()
         from .optimizer import states_to_host
         states = getattr(self._updater, "states", None) or {}
         blob = {"optimizer": self._optimizer_blob,
@@ -175,8 +223,14 @@ _LOCAL_KINDS = ("local", "local_update_cpu", "local_allreduce_cpu",
                 "device", "local_allreduce_device")
 
 
-def create(name: str = "local") -> KVStore:
+def create(name: str = "local",
+           compression: Optional[str] = None,
+           bucket_bytes: Optional[int] = None) -> KVStore:
     """Create a store by type (reference ``kvstore.cc:17-48``).
+
+    ``compression``/``bucket_bytes`` configure the gradient-communication
+    path (quantized collectives, fusion bucket size); both default off /
+    ~4 MiB.
 
     For ``dist*`` kinds, non-worker processes never return: a process
     launched with role ``server``/``scheduler`` runs its blocking loop and
@@ -186,7 +240,8 @@ def create(name: str = "local") -> KVStore:
     if not isinstance(name, str):
         raise MXNetError("name must be a string")
     if name in _LOCAL_KINDS:
-        return KVStore(name)
+        return KVStore(name, compression=compression,
+                       bucket_bytes=bucket_bytes)
     if name.startswith("dist"):
         import sys
         from .parallel import dist_kvstore as dkv
@@ -198,6 +253,7 @@ def create(name: str = "local") -> KVStore:
         if role == "server":
             dkv.run_server(cfg)
             sys.exit(0)
-        return dkv.DistKVStore(name)
+        return dkv.DistKVStore(name, compression=compression,
+                               bucket_bytes=bucket_bytes)
     raise MXNetError(f"unknown kvstore type {name}; known: "
                      f"{_LOCAL_KINDS + ('dist', 'dist_sync', 'dist_async')}")

@@ -9,11 +9,31 @@ the per-device values (zero-copy via
 ``shard_map``/``psum`` — XLA lowers it to an ICI all-reduce, no host
 round-trips.  This backs the KVStore ``device``/``local`` tiers when the
 pushed values live on distinct devices.
+
+Gradient fusion (this module's perf layer): issuing one collective per
+tensor makes every BN scale / bias pay full dispatch + latency cost, the
+failure mode the reference paper's dependency engine avoids by overlapping
+push with backward.  :func:`allreduce_sum`/:func:`allreduce_mean` therefore
+accept a *list of gradient groups* and fuse them into size-targeted
+**buckets** (DDP-style flat buffers, default ~4 MiB): tensors are
+flattened, laid end-to-end in priority order (higher ``priority`` →
+earlier bucket, the contract ``KVStore.push(priority=...)`` advertises),
+and each bucket is reduced as ONE fused program.  A tensor that straddles
+a bucket boundary is split, so exactly ``ceil(total_bytes/bucket_bytes)``
+programs are dispatched per dtype class.  Dispatch is async (JAX returns
+futures), so early buckets reduce while later ones are still being
+assembled — compute/comm overlap without an engine thread.
+
+Optional quantized reduction (``compression='int8' | 'bf16'``) implements
+EQuARX-style scale-per-bucket quantize → all-reduce → dequantize inside
+the same fused program; see :func:`psum_compressed`.
 """
 from __future__ import annotations
 
+import contextlib
 import functools
-from typing import List, Sequence
+import threading
+from typing import Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -22,8 +42,21 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..base import MXNetError
+from .._compat import shard_map
 
-__all__ = ["allreduce_sum", "allreduce_mean", "distinct_devices"]
+__all__ = ["allreduce_sum", "allreduce_mean", "distinct_devices",
+           "psum_compressed", "count_collectives", "CollectiveStats",
+           "DEFAULT_BUCKET_BYTES", "COMPRESSIONS", "plan_buckets"]
+
+DEFAULT_BUCKET_BYTES = 4 << 20  # ~4 MiB, the classic DDP default
+COMPRESSIONS = (None, "int8", "bf16")
+
+
+def check_compression(compression: Optional[str]) -> Optional[str]:
+    if compression not in COMPRESSIONS:
+        raise MXNetError(f"unknown compression {compression!r}; "
+                         f"expected one of {COMPRESSIONS}")
+    return compression
 
 
 def distinct_devices(arrays: Sequence[jax.Array]) -> bool:
@@ -43,53 +76,312 @@ def distinct_devices(arrays: Sequence[jax.Array]) -> bool:
     return True
 
 
+# ---------------------------------------------------------------------------
+# counting hook — lets tests assert how many fused programs a reduction
+# dispatched (and how big they were) without reaching into XLA.
+
+_dispatch_hooks: List[Callable[[dict], None]] = []
+_hook_lock = threading.Lock()
+
+
+class CollectiveStats:
+    """Record of collective dispatches seen inside a
+    :func:`count_collectives` scope."""
+
+    def __init__(self):
+        self.records: List[dict] = []
+
+    def _record(self, rec: dict) -> None:
+        self.records.append(rec)
+
+    @property
+    def count(self) -> int:
+        return len(self.records)
+
+    @property
+    def total_bytes(self) -> int:
+        return sum(r["nbytes"] for r in self.records)
+
+    def __repr__(self):
+        return f"CollectiveStats(count={self.count}, bytes={self.total_bytes})"
+
+
+@contextlib.contextmanager
+def count_collectives():
+    """``with count_collectives() as stats: ...`` — counts every fused
+    all-reduce program dispatched by this module (one per bucket)."""
+    stats = CollectiveStats()
+    with _hook_lock:
+        _dispatch_hooks.append(stats._record)
+    try:
+        yield stats
+    finally:
+        with _hook_lock:
+            _dispatch_hooks.remove(stats._record)
+
+
+def _emit(rec: dict) -> None:
+    if _dispatch_hooks:
+        with _hook_lock:
+            hooks = list(_dispatch_hooks)
+        for h in hooks:
+            h(rec)
+
+
+# ---------------------------------------------------------------------------
+# quantized psum — usable standalone inside any shard_map body (the
+# ShardedTrainer grad path imports it) and by the bucket programs below.
+
+def psum_compressed(x: jax.Array, axis_name: str,
+                    compression: Optional[str] = None) -> jax.Array:
+    """All-reduce-sum ``x`` over ``axis_name``, optionally through a
+    quantized wire format.
+
+    ``'int8'``: scale-per-buffer symmetric quantization — every shard
+    quantizes with the same global scale (``pmax`` of the per-shard
+    absmax), the reduce runs on int32 lanes (exact for any realistic
+    device count), then one dequantize multiply.  4x (f32) / 2x (bf16)
+    less wire traffic at ~1/254 relative rounding error per element.
+
+    ``'bf16'``: cast → psum → cast back; exact for values already bf16.
+
+    Non-float inputs ignore ``compression`` (quantizing indices or bool
+    masks is never right) and take the plain psum.
+    """
+    check_compression(compression)
+    if compression is None or not jnp.issubdtype(x.dtype, jnp.floating):
+        return jax.lax.psum(x, axis_name)
+    if compression == "bf16":
+        return jax.lax.psum(x.astype(jnp.bfloat16), axis_name).astype(x.dtype)
+    # int8: one scale per buffer, shared across shards via pmax
+    xf = x.astype(jnp.float32)
+    absmax = jax.lax.pmax(jnp.max(jnp.abs(xf)), axis_name)
+    scale = jnp.maximum(absmax, jnp.float32(1e-30)) / jnp.float32(127.0)
+    q = jnp.clip(jnp.round(xf / scale), -127.0, 127.0).astype(jnp.int8)
+    s = jax.lax.psum(q.astype(jnp.int32), axis_name)
+    return (s.astype(jnp.float32) * scale).astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# fused bucket programs
+
 @functools.lru_cache(maxsize=None)
-def _allreduce_prog(devices, mean: bool):
+def _allreduce_prog(devices, mean: bool, compression: Optional[str]):
     mesh = Mesh(np.array(devices), ("dev",))
     n = len(devices)
 
     def body(x):
-        s = jax.lax.psum(x, "dev")
+        s = psum_compressed(x, "dev", compression)
         return s / n if mean else s
 
-    return jax.jit(jax.shard_map(body, mesh=mesh, in_specs=P("dev"),
-                                 out_specs=P("dev"))), mesh
+    return jax.jit(shard_map(body, mesh=mesh, in_specs=P("dev"),
+                             out_specs=P("dev"))), mesh
 
 
-def _allreduce(arrays: List[jax.Array], mean: bool) -> List[jax.Array]:
-    if len(arrays) == 1:
-        return list(arrays)
-    if not distinct_devices(arrays):
-        # degenerate tier (shards co-resident): plain tree sum on device —
-        # the single-device path the reference also special-cases
-        acc = arrays[0]
-        for a in arrays[1:]:
-            acc = acc + jax.device_put(a, next(iter(arrays[0].devices())))
-        if mean:
-            acc = acc / len(arrays)
-        return [acc] * len(arrays)
-    shape = arrays[0].shape
-    dtype = arrays[0].dtype
-    for a in arrays[1:]:
-        if a.shape != shape or a.dtype != dtype:
-            raise MXNetError("allreduce: mismatched shapes/dtypes")
-    devices = tuple(next(iter(a.devices())) for a in arrays)
-    prog, mesh = _allreduce_prog(devices, mean)
+def _reduce_stacked(arrays: List[jax.Array], devices, mean: bool,
+                    compression: Optional[str]) -> List[jax.Array]:
+    """One fused all-reduce over N per-device arrays of identical shape.
+    Returns the reduced value per device, input order."""
+    shape = tuple(arrays[0].shape)
+    prog, mesh = _allreduce_prog(devices, mean, compression)
     shards = [a[None] for a in arrays]  # (1, *shape), stays on its device
     global_arr = jax.make_array_from_single_device_arrays(
-        (len(arrays),) + tuple(shape), NamedSharding(mesh, P("dev")), shards)
+        (len(arrays),) + shape, NamedSharding(mesh, P("dev")), shards)
     out = prog(global_arr)
-    # per-device results, in input order (addressable_shards order matches
-    # the mesh's device order == input order)
     by_dev = {s.device: s.data for s in out.addressable_shards}
     return [by_dev[d][0] for d in devices]
 
 
-def allreduce_sum(arrays: List[jax.Array]) -> List[jax.Array]:
-    """Sum N same-shaped arrays living on N devices; each device gets the
-    total.  One XLA all-reduce over ICI."""
-    return _allreduce(list(arrays), mean=False)
+# ---------------------------------------------------------------------------
+# bucket planning
+
+def plan_buckets(elem_counts: Sequence[int], itemsize: int,
+                 bucket_bytes: int) -> List[List[Tuple[int, int, int]]]:
+    """Slice tensors (given in dispatch order) into flat buckets.
+
+    Returns a list of buckets; each bucket is a list of
+    ``(tensor_index, start_elem, stop_elem)`` pieces.  Tensors straddling
+    a bucket boundary are split, so the plan always has exactly
+    ``ceil(total_elems / elems_per_bucket)`` buckets.
+    """
+    elems_per_bucket = max(1, int(bucket_bytes) // max(1, itemsize))
+    buckets: List[List[Tuple[int, int, int]]] = []
+    cur: List[Tuple[int, int, int]] = []
+    cur_elems = 0
+    for idx, n in enumerate(elem_counts):
+        start = 0
+        while start < n:
+            take = min(n - start, elems_per_bucket - cur_elems)
+            cur.append((idx, start, start + take))
+            cur_elems += take
+            start += take
+            if cur_elems == elems_per_bucket:
+                buckets.append(cur)
+                cur, cur_elems = [], 0
+    if cur:
+        buckets.append(cur)
+    return buckets
 
 
-def allreduce_mean(arrays: List[jax.Array]) -> List[jax.Array]:
-    return _allreduce(list(arrays), mean=True)
+def _group_devices(group: List[jax.Array]):
+    return tuple(next(iter(a.devices())) for a in group)
+
+
+def _allreduce_bucketed(groups: List[List[jax.Array]], mean: bool,
+                        priorities: Optional[Sequence[int]],
+                        bucket_bytes: int,
+                        compression: Optional[str]) -> List[List[jax.Array]]:
+    """Reduce many gradient groups (each: one value per device) through
+    fused flat buckets.  Returns reduced groups in the input order."""
+    ngroups = len(groups)
+    if priorities is not None and len(priorities) != ngroups:
+        raise MXNetError("allreduce: priorities length mismatch")
+    devices = _group_devices(groups[0])
+    for g in groups[1:]:
+        if _group_devices(g) != devices:
+            raise MXNetError("allreduce: bucketed groups must share one "
+                             "device set in one order")
+    for g in groups:
+        shape, dtype = g[0].shape, g[0].dtype
+        for a in g[1:]:
+            if a.shape != shape or a.dtype != dtype:
+                raise MXNetError("allreduce: mismatched shapes/dtypes")
+
+    # dispatch order: higher priority first (the contract KVStore.push
+    # advertises); stable for ties so same-priority grads keep push order
+    order = sorted(range(ngroups),
+                   key=(lambda i: -priorities[i]) if priorities is not None
+                   else (lambda i: 0))
+
+    # dtype classes can't share a flat buffer; plan each independently
+    by_dtype: dict = {}
+    for i in order:
+        by_dtype.setdefault(jnp.dtype(groups[i][0].dtype), []).append(i)
+
+    results: List[Optional[List[jax.Array]]] = [None] * ngroups
+    pieces_out: dict = {i: [] for i in range(ngroups)}  # idx -> [(per-dev flat piece list)]
+
+    for dtype, idxs in by_dtype.items():
+        counts = [int(np.prod(groups[i][0].shape, dtype=np.int64))
+                  for i in idxs]
+        # zero-size tensors contribute nothing; pass them through
+        sized = [(i, c) for i, c in zip(idxs, counts) if c > 0]
+        for i, c in zip(idxs, counts):
+            if c == 0:
+                results[i] = list(groups[i])
+        if not sized:
+            continue
+        plan = plan_buckets([c for _, c in sized], dtype.itemsize,
+                            bucket_bytes)
+        flats = {i: [a.ravel() for a in groups[i]] for i, _ in sized}
+        for bucket in plan:
+            # assemble the flat buffer per device, then dispatch at once —
+            # JAX async dispatch returns immediately, so this bucket's
+            # reduce overlaps with assembling the next
+            per_dev: List[jax.Array] = []
+            for d_i in range(len(devices)):
+                segs = []
+                for piece_i, (start, stop) in ((sized[pi][0], (s0, s1))
+                                               for pi, s0, s1 in bucket):
+                    flat = flats[piece_i][d_i]
+                    segs.append(flat if (start == 0 and stop == flat.size)
+                                else flat[start:stop])
+                per_dev.append(segs[0] if len(segs) == 1
+                               else jnp.concatenate(segs))
+            reduced = _reduce_stacked(per_dev, devices, mean, compression)
+            _emit({"nbytes": int(per_dev[0].size) * dtype.itemsize,
+                   "num_pieces": len(bucket),
+                   "tensor_indices": [sized[pi][0] for pi, _, _ in bucket],
+                   "dtype": str(dtype), "compression": compression,
+                   "mean": mean, "kind": "bucket"})
+            # carve the reduced flat buffer back into tensor pieces
+            off = 0
+            for pi, start, stop in bucket:
+                idx = sized[pi][0]
+                ln = stop - start
+                pieces_out[idx].append(
+                    [r[off:off + ln] for r in reduced])
+                off += ln
+
+    for idx in range(ngroups):
+        if results[idx] is not None:
+            continue
+        shape = tuple(groups[idx][0].shape)
+        outs = []
+        for d_i in range(len(devices)):
+            parts = [p[d_i] for p in pieces_out[idx]]
+            flat = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+            outs.append(flat.reshape(shape))
+        results[idx] = outs
+    return results  # type: ignore[return-value]
+
+
+# ---------------------------------------------------------------------------
+# public API
+
+def _allreduce(arrays, mean: bool, priorities=None,
+               bucket_bytes: Optional[int] = None,
+               compression: Optional[str] = None):
+    check_compression(compression)
+    if bucket_bytes is None:
+        bucket_bytes = DEFAULT_BUCKET_BYTES
+    arrays = list(arrays)
+    if not arrays:
+        return []
+    grouped = isinstance(arrays[0], (list, tuple))
+    groups = [list(g) for g in arrays] if grouped else [arrays]
+
+    # groups whose members are NOT on distinct devices take the degenerate
+    # co-resident path (plain tree sum) — the single-device tier the
+    # reference also special-cases
+    flat_out: List[List[jax.Array]] = [None] * len(groups)  # type: ignore
+    bucketable: List[int] = []
+    for gi, g in enumerate(groups):
+        if len(g) == 1:
+            flat_out[gi] = list(g)
+        elif not distinct_devices(g):
+            acc = g[0]
+            for a in g[1:]:
+                acc = acc + jax.device_put(a, next(iter(g[0].devices())))
+            if mean:
+                acc = acc / len(g)
+            _emit({"nbytes": int(acc.size) * acc.dtype.itemsize,
+                   "num_pieces": 1, "tensor_indices": [gi],
+                   "dtype": str(acc.dtype), "compression": None,
+                   "mean": mean, "kind": "tree"})
+            flat_out[gi] = [acc] * len(g)
+        else:
+            bucketable.append(gi)
+
+    if bucketable:
+        sub_prior = ([priorities[gi] for gi in bucketable]
+                     if priorities is not None else None)
+        reduced = _allreduce_bucketed([groups[gi] for gi in bucketable],
+                                      mean, sub_prior, bucket_bytes,
+                                      compression)
+        for gi, r in zip(bucketable, reduced):
+            flat_out[gi] = r
+
+    return flat_out if grouped else flat_out[0]
+
+
+def allreduce_sum(arrays, *, priorities=None,
+                  bucket_bytes: Optional[int] = None,
+                  compression: Optional[str] = None):
+    """All-reduce-sum per-device arrays; each device gets the total.
+
+    ``arrays`` is either one group (a flat list of same-shaped arrays,
+    one per device — the classic single-tensor call) or a list of groups
+    (one group per gradient).  Groups are fused into ~``bucket_bytes``
+    flat buckets dispatched in descending ``priorities`` order; each
+    bucket is ONE compiled all-reduce over ICI.  ``compression`` selects
+    the quantized wire format (see :func:`psum_compressed`)."""
+    return _allreduce(arrays, mean=False, priorities=priorities,
+                      bucket_bytes=bucket_bytes, compression=compression)
+
+
+def allreduce_mean(arrays, *, priorities=None,
+                   bucket_bytes: Optional[int] = None,
+                   compression: Optional[str] = None):
+    return _allreduce(arrays, mean=True, priorities=priorities,
+                      bucket_bytes=bucket_bytes, compression=compression)

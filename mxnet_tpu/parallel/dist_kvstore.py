@@ -90,6 +90,46 @@ def _unpack_arr(t: Tuple[str, tuple, bytes]) -> np.ndarray:
     return np.frombuffer(raw, dtype=dtype).reshape(shape).copy()
 
 
+def _pack_wire(a: np.ndarray, compression: Optional[str]) -> tuple:
+    """Pack a gradient for the worker->server push wire.
+
+    ``'int8'``: symmetric scale-per-message quantization (4x smaller for
+    f32); the server dequantizes before accumulating, so each worker's
+    contribution carries its own scale.  ``'bf16'``: 2-byte mantissa
+    truncation.  Non-float payloads and ``None`` go raw.  Pulls always
+    return full precision — only gradients tolerate lossy wire formats.
+    """
+    a = np.ascontiguousarray(a)
+    if compression is None or a.dtype.kind != "f":
+        return ("raw",) + _pack_arr(a)
+    if compression == "bf16":
+        import ml_dtypes
+        return ("bf16", str(a.dtype), a.shape,
+                a.astype(ml_dtypes.bfloat16).tobytes())
+    absmax = float(np.max(np.abs(a))) if a.size else 0.0
+    scale = max(absmax, 1e-30) / 127.0
+    q = np.clip(np.round(a / scale), -127, 127).astype(np.int8)
+    return ("q8", str(a.dtype), a.shape, scale, q.tobytes())
+
+
+def _unpack_wire(t: tuple) -> np.ndarray:
+    if len(t) == 3:  # legacy bare (dtype, shape, bytes)
+        return _unpack_arr(t)
+    tag = t[0]
+    if tag == "raw":
+        return _unpack_arr(t[1:])
+    if tag == "bf16":
+        import ml_dtypes
+        _, dtype, shape, raw = t
+        return np.frombuffer(raw, dtype=ml_dtypes.bfloat16) \
+            .reshape(shape).astype(dtype)
+    if tag == "q8":
+        _, dtype, shape, scale, raw = t
+        q = np.frombuffer(raw, dtype=np.int8).reshape(shape)
+        return (q.astype(np.float32) * np.float32(scale)).astype(dtype)
+    raise MXNetError(f"unknown wire tag {tag!r}")
+
+
 def role_from_env() -> Dict[str, Any]:
     """Cluster config from env (launcher-provided; DMLC_* names accepted
     for reference-launcher compatibility)."""
@@ -353,7 +393,7 @@ def run_server(cfg: Optional[Dict[str, Any]] = None) -> None:
                         state.init_key(msg[1], _unpack_arr(msg[2]))
                         _send(conn, ("ok",))
                     elif kind == "push":
-                        state.push(msg[1], _unpack_arr(msg[2]))
+                        state.push(msg[1], _unpack_wire(msg[2]))
                         _send(conn, ("ok",))
                     elif kind == "pull":
                         _send(conn, ("ok", _pack_arr(state.pull(msg[1]))))
@@ -491,8 +531,11 @@ class _PrioritySender:
 class DistKVStore(KVStore):
     """Worker-side distributed store (reference ``KVStoreDist``)."""
 
-    def __init__(self, kind: str = "dist_sync"):
-        super().__init__(kind)
+    def __init__(self, kind: str = "dist_sync",
+                 compression: Optional[str] = None,
+                 bucket_bytes: Optional[int] = None):
+        super().__init__(kind, compression=compression,
+                         bucket_bytes=bucket_bytes)
         cfg = role_from_env()
         if not cfg:
             raise MXNetError(
@@ -583,7 +626,7 @@ class DistKVStore(KVStore):
         if len(datas) == 1:
             return np.asarray(datas[0])
         from .collectives import allreduce_sum
-        reduced = allreduce_sum(list(datas))
+        reduced = allreduce_sum(list(datas), compression=self._compression)
         return np.asarray(reduced[0])
 
     def push(self, key, value, priority: int = 0) -> None:
@@ -611,7 +654,9 @@ class DistKVStore(KVStore):
                 evs.append(self._senders[sid].submit(
                     priority,
                     lambda sid=sid, wkey=wkey, sl=sl, h=holder:
-                    self._rpc(sid, ("push", wkey, _pack_arr(h.get()[sl])))))
+                    self._rpc(sid, ("push", wkey,
+                                    _pack_wire(h.get()[sl],
+                                               self._compression)))))
 
     def pull(self, key, out=None, priority: int = 0) -> None:
         """Pull blocks until ``out`` is filled, but shard requests fan out

@@ -23,10 +23,14 @@ serves both (same pattern as ``ops/nn_ops._softmax_rows``).
 from __future__ import annotations
 
 import functools
+import logging
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
+
+from .._compat import (enable_x64, pallas_tpu_compiler_params,
+                       platform_dependent)
 
 NEG_INF = -1e30
 
@@ -116,7 +120,7 @@ def _fwd_kernel(causal, scale, bq, bk, d, nheads,
                 q, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=f32) * scale    # [bq, bk]
             if causal:
-                s = jnp.where(mask, s, NEG_INF)
+                s = jnp.where(mask, s, jnp.asarray(NEG_INF, s.dtype))
             m_prev = m_h[:, :1]                        # [bq, 1]
             l_prev = l_h[:, :1]
             m_blk = jnp.max(s, axis=-1, keepdims=True)
@@ -124,7 +128,7 @@ def _fwd_kernel(causal, scale, bq, bk, d, nheads,
             alpha = jnp.exp(m_prev - m_new)
             p = jnp.exp(s - m_new)                     # [bq, bk] f32
             if causal:
-                p = jnp.where(mask, p, 0.0)
+                p = jnp.where(mask, p, jnp.asarray(0.0, p.dtype))
             l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
             pv = jax.lax.dot_general(
                 p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
@@ -145,7 +149,7 @@ def _fwd_kernel(causal, scale, bq, bk, d, nheads,
                 m_h, l_h, acc_h = m_s[h], l_s[h], acc_s[h]
             else:
                 m_h, l_h, acc_h = m_s[:], l_s[:], acc_s[:]
-            l = jnp.maximum(l_h[:, :1], 1e-30)
+            l = jnp.maximum(l_h[:, :1], jnp.asarray(1e-30, l_h.dtype))
             out = (acc_h / l).astype(o_ref.dtype)
             # row stats ride an 8-sublane broadcast: Mosaic requires
             # block shapes with second-to-last dim divisible by 8
@@ -163,9 +167,11 @@ def _flash_fwd_pallas(q, k, v, causal, scale, bq, bk, interpret=False,
                       blhd=False):
     """bhld: q/k/v [BH, L, D] -> (out [BH, L, D], lse [BH, 8, L] f32).
     blhd: q/k/v [B, L, H, D] -> (out [B, L, H, D], lse [B, H, 8, L]) —
-    blocks slice straight out of the layout the model produces, so no
-    head transpose ever materializes (measured ~5 ms/step of pure data
-    formatting at the 6L d512 seq-2048 LM)."""
+    blocks slice straight out of the layout the model produces, no head
+    transpose.  INTERPRET-ONLY for now: Mosaic's lowering rejects the
+    per-head sub-tile slices, so the real-TPU dispatch (see
+    ``flash_attention``) transposes blhd inputs to the bhld kernel
+    instead; the ~5 ms/step transpose saving is unrealized on hardware."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -228,7 +234,7 @@ def _flash_fwd_pallas(q, k, v, causal, scale, bq, bk, interpret=False,
             pltpu.VMEM((bq, 128), jnp.float32),   # running sum
             pltpu.VMEM((bq, d), jnp.float32),     # accumulator
         ]
-    with jax.enable_x64(False):
+    with enable_x64(False):
         return pl.pallas_call(
             kern,
             grid=grid,
@@ -236,7 +242,7 @@ def _flash_fwd_pallas(q, k, v, causal, scale, bq, bk, interpret=False,
             out_specs=out_specs,
             out_shape=out_shape,
             scratch_shapes=scratch,
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=pallas_tpu_compiler_params(
                 dimension_semantics=("parallel", "parallel", "arbitrary")),
             interpret=interpret,
         )(q, k, v)
@@ -294,7 +300,7 @@ def _dq_kernel(causal, scale, bq, bk, d, nheads,
                 q, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=f32) * scale
             if causal:
-                s = jnp.where(mask, s, NEG_INF)
+                s = jnp.where(mask, s, jnp.asarray(NEG_INF, s.dtype))
             p = jnp.exp(s - lse)                        # [bq, bk]
             dp = jax.lax.dot_general(
                 do, v, (((1,), (1,)), ((), ())),
@@ -357,7 +363,7 @@ def _dkv_kernel(causal, scale, bq, bk, d, nheads,
                 q, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=f32) * scale     # [bq, bk]
             if causal:
-                s = jnp.where(mask, s, NEG_INF)
+                s = jnp.where(mask, s, jnp.asarray(NEG_INF, s.dtype))
             p = jnp.exp(s - lse)                        # [bq, bk]
             # dv += p^T @ do
             dv_upd = jax.lax.dot_general(
@@ -437,7 +443,7 @@ def _flash_bwd_pallas(q, k, v, out, lse, do, causal, scale, bq, bk,
     nh = h if blhd else 0
     dq_scr = (pltpu.VMEM((h, bq, d), jnp.float32) if blhd
               else pltpu.VMEM((bq, d), jnp.float32))
-    with jax.enable_x64(False):
+    with enable_x64(False):
         dq = pl.pallas_call(
             functools.partial(_dq_kernel, causal, scale, bq, bk, d, nh),
             grid=grid_dq,
@@ -445,7 +451,7 @@ def _flash_bwd_pallas(q, k, v, out, lse, do, causal, scale, bq, bk,
             out_specs=[qspec],
             out_shape=[dq_shape],
             scratch_shapes=[dq_scr],
-            compiler_params=pltpu.CompilerParams(dimension_semantics=sem),
+            compiler_params=pallas_tpu_compiler_params(dimension_semantics=sem),
             interpret=interpret,
         )(q, k, v, do, lse8, delta8)[0]
 
@@ -484,7 +490,7 @@ def _flash_bwd_pallas(q, k, v, out, lse, do, causal, scale, bq, bk,
             out_specs=[kspec2, kspec2],
             out_shape=[dk_shape, dv_shape],
             scratch_shapes=list(kv_scr),
-            compiler_params=pltpu.CompilerParams(dimension_semantics=sem),
+            compiler_params=pallas_tpu_compiler_params(dimension_semantics=sem),
             interpret=interpret,
         )(q, k, v, do, lse8, delta8)
     return dq, dk, dv
@@ -525,23 +531,58 @@ def _wrap_for_mesh(pallas_path, q, blhd=False):
     (``data``) and head (``model``) dims so every device runs it on its
     local shard.  Attention is batch- and head-local, so this is exact."""
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
-    from .mesh import current_mesh
+    from .._compat import shard_map
+    from .mesh import DATA_AXIS, MODEL_AXIS, current_mesh
 
     try:
         manual = bool(jax.typeof(q).vma)
     except AttributeError:
-        manual = False
+        # old jax has no varying-manual-axes on the tracer type; a
+        # shard_map region shows up as bound names in the axis env
+        try:
+            from jax._src.core import get_axis_env
+            manual = bool(get_axis_env().axis_sizes)
+        except Exception:
+            manual = False
     mesh = current_mesh()
     if manual or mesh is None:
         return pallas_path
     b = q.shape[0]
     h = q.shape[2] if blhd else q.shape[1]
-    baxis = next((a for a in ("data",) if a in mesh.axis_names
-                  and mesh.shape[a] > 1 and b % mesh.shape[a] == 0), None)
-    haxis = next((a for a in ("model",) if a in mesh.axis_names
-                  and mesh.shape[a] > 1 and h % mesh.shape[a] == 0), None)
+
+    def _spec_axes(dim_index):
+        # candidate axes for a dim, best first: what the operand's OWN
+        # sharding says (modern jax carries it on the tracer type), then
+        # the canonical mesh axis name for that role
+        cands = []
+        try:
+            entry = jax.typeof(q).sharding.spec[dim_index]
+            cands += list(entry) if isinstance(entry, tuple) \
+                else ([entry] if entry else [])
+        except (AttributeError, IndexError, TypeError):
+            pass
+        cands.append(DATA_AXIS if dim_index == 0 else MODEL_AXIS)
+        return cands
+
+    def _pick(dim, cands, used=()):
+        for a in cands:
+            if (a not in used and a in mesh.axis_names
+                    and mesh.shape[a] > 1 and dim % mesh.shape[a] == 0):
+                return a
+        return None
+
+    baxis = _pick(b, _spec_axes(0))
+    haxis = _pick(h, _spec_axes(2 if blhd else 1), used=(baxis,))
     if baxis is None and haxis is None:
+        if mesh.size > 1:
+            # a >1-device mesh with no recognizable batch/head axis:
+            # the kernel will run replicated behind all-gathers — loud
+            # hint instead of silent perf loss on nonstandard meshes
+            logging.getLogger(__name__).warning(
+                "flash_attention: active mesh %s has no axis usable to "
+                "shard batch=%d or heads=%d (canonical names %r/%r); "
+                "running the kernel unpartitioned", dict(mesh.shape), b,
+                h, DATA_AXIS, MODEL_AXIS)
         return pallas_path
     spec = (P(baxis, None, haxis, None) if blhd
             else P(baxis, haxis, None, None))
@@ -549,9 +590,10 @@ def _wrap_for_mesh(pallas_path, q, blhd=False):
         return shard_map(pallas_path, mesh=mesh,
                          in_specs=(spec, spec, spec), out_specs=spec,
                          check_vma=False)
-    except TypeError:
+    except TypeError:  # older jax spells it check_rep
         return shard_map(pallas_path, mesh=mesh,
-                         in_specs=(spec, spec, spec), out_specs=spec)
+                         in_specs=(spec, spec, spec), out_specs=spec,
+                         check_rep=False)
 
 
 def flash_attention_stats(q, k, v, *, causal=False, scale=None,
@@ -591,7 +633,7 @@ def flash_attention_stats(q, k, v, *, causal=False, scale=None,
 
     if interpret:
         return pallas_path(q, k, v)
-    return jax.lax.platform_dependent(q, k, v,
+    return platform_dependent(q, k, v,
                                       cpu=ref_path, default=pallas_path)
 
 
@@ -620,10 +662,10 @@ def _block_bwd_jnp(q, k, v, out, lse, do, causal, scale, block,
         if causal:
             kpos = i * block + jnp.arange(block)
             mask = (qpos[:, None] >= kpos[None, :])[None, None]
-            s = jnp.where(mask, s, NEG_INF)
+            s = jnp.where(mask, s, jnp.asarray(NEG_INF, s.dtype))
         p = jnp.exp(s - lse[..., None])                          # [.., lq, blk]
         if causal:
-            p = jnp.where(mask, p, 0.0)
+            p = jnp.where(mask, p, jnp.asarray(0.0, p.dtype))
         dv_b = jnp.einsum("bhqk,bhqd->bhkd", p.astype(do.dtype), do)
         dp = jnp.einsum("bhqd,bhkd->bhqk", do, v_b).astype(f32)
         ds = p * (dp - delta[..., None])
@@ -680,7 +722,7 @@ def flash_attention_block_bwd(q, k, v, out, lse, do, *, causal=False,
 
     if interpret:
         return pallas_path(q, k, v, out, lse, do)
-    return jax.lax.platform_dependent(q, k, v, out, lse, do,
+    return platform_dependent(q, k, v, out, lse, do,
                                       cpu=ref_path, default=pallas_path)
 
 
@@ -694,9 +736,12 @@ def flash_attention(q, k, v, *, causal=False, scale=None,
 
     ``layout``: ``"bhld"`` takes ``[B, H, L, D]``; ``"blhd"`` takes
     ``[B, L, H, D]`` — the layout attention inputs naturally have after
-    per-position projections — and the kernel slices head-blocks
-    straight out of it, so NO head transpose ever materializes (worth
-    ~5 ms/step of pure data movement on the 6L d512 seq-2048 LM).
+    per-position projections.  The native blhd kernels (which slice head
+    blocks straight out of that layout, no transpose) are currently
+    INTERPRET-ONLY: Mosaic rejects their per-head sub-tile slices, so on
+    a real TPU the blhd path transposes to the proven bhld kernel.  The
+    transpose-free win (~5 ms/step of pure data movement on the 6L d512
+    seq-2048 LM) lands only once Mosaic supports sub-tile head slicing.
     """
     from .ring_attention import blockwise_attention
 
@@ -771,5 +816,5 @@ def flash_attention(q, k, v, *, causal=False, scale=None,
     pallas_path = _wrap_for_mesh(pallas_path, q, blhd=blhd)
     if interpret:
         return pallas_path(q, k, v)
-    return jax.lax.platform_dependent(q, k, v,
+    return platform_dependent(q, k, v,
                                       cpu=ref_path, default=pallas_path)

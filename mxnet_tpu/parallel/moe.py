@@ -140,7 +140,7 @@ def moe_ffn_ep(x, gate_w, w1, b1, w2, b2, mesh, k: int = 2,
     """
     from functools import partial
 
-    from jax import shard_map
+    from .._compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     ep = mesh.shape[expert_axis]

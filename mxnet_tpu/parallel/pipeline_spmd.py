@@ -62,7 +62,7 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from .._compat import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..base import MXNetError
@@ -163,12 +163,48 @@ class _FlatSpec:
         return out
 
 
+class _StackedStateGuard:
+    """Data descriptor guarding ``_params``/``_aux``/``_opt_state`` on
+    :class:`SpmdPipelineTrainer`: after ``_compile`` the per-stage dicts
+    live only in the stacked pipe-sharded buffers (``_pflat``/``_sflat``/
+    ``_auxflat``) and the originals are dropped to free memory.  An
+    inherited :class:`PipelineTrainer` code path that still reaches for
+    them gets a clear ``RuntimeError`` naming the supported surface
+    instead of a cryptic ``'NoneType' object is not subscriptable``."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.slot = "_guarded" + name
+
+    def __get__(self, obj, objtype=None):
+        if obj is None:
+            return self
+        if self.slot not in obj.__dict__:
+            raise AttributeError(self.name)
+        val = obj.__dict__[self.slot]
+        if val is None:
+            raise RuntimeError(
+                f"SpmdPipelineTrainer.{self.name} is dropped after "
+                "compile: per-stage params/aux/optimizer state live only "
+                "in the stacked pipe-sharded buffers.  Use get_params() "
+                "for host copies, or step()/forward(), which read the "
+                "stacked buffers directly.")
+        return val
+
+    def __set__(self, obj, value):
+        obj.__dict__[self.slot] = value
+
+
 class SpmdPipelineTrainer(PipelineTrainer):
     """:class:`PipelineTrainer` with the whole 1F1B step in ONE program.
 
     Same constructor and :meth:`bind` signature; ``step()`` makes
     exactly one compiled dispatch (``self.dispatch_count`` counts them).
     """
+
+    _params = _StackedStateGuard("_params")
+    _aux = _StackedStateGuard("_aux")
+    _opt_state = _StackedStateGuard("_opt_state")
 
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)

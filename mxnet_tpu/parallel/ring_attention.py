@@ -20,7 +20,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from .._compat import shard_map
 
 from .mesh import SEQ_AXIS
 
@@ -154,7 +154,11 @@ def _ring_flash_fwd_impl(q, k, v, axis_name, causal, scale):
     from .flash_attention import NEG_INF, flash_attention_stats
 
     axis_size = jax.lax.psum(1, axis_name)
-    my_idx = jax.lax.axis_index(axis_name)
+    # only the causal branch consumes the device index; tracing it in the
+    # non-causal path leaves a dead partition-id op that the custom_vjp
+    # call keeps alive, and the SPMD partitioner rejects a partition-id
+    # with no manual-sharded consumer ("meaning is ambiguous")
+    my_idx = jax.lax.axis_index(axis_name) if causal else None
     f32 = jnp.float32
     d = q.shape[-1]
     scale_f = float(1.0 / (d ** 0.5)) if scale is None else float(scale)
@@ -177,8 +181,8 @@ def _ring_flash_fwd_impl(q, k, v, axis_name, causal, scale):
 
     def step(carry, i):
         k_blk, v_blk, o, lse = carry
-        kv_idx = (my_idx - i) % axis_size
         if causal:
+            kv_idx = (my_idx - i) % axis_size
             out_i, lse_i = jax.lax.cond(
                 kv_idx == my_idx, diag_fn,
                 lambda ops: jax.lax.cond(kv_idx < my_idx, full_fn,
@@ -222,7 +226,7 @@ def _ring_flash_bwd_rule(axis_name, causal, scale, res, do):
 
     q, k, v, out, lse = res
     axis_size = jax.lax.psum(1, axis_name)
-    my_idx = jax.lax.axis_index(axis_name)
+    my_idx = jax.lax.axis_index(axis_name) if causal else None
     f32 = jnp.float32
     d = q.shape[-1]
     scale_f = float(1.0 / (d ** 0.5)) if scale is None else float(scale)
@@ -251,8 +255,8 @@ def _ring_flash_bwd_rule(axis_name, causal, scale, res, do):
 
     def step(carry, i):
         k_blk, v_blk, dk_acc, dv_acc, dq = carry
-        kv_idx = (my_idx - i) % axis_size
         if causal:
+            kv_idx = (my_idx - i) % axis_size
             dq_i, dk_i, dv_i = jax.lax.cond(
                 kv_idx == my_idx, diag_b,
                 lambda ops: jax.lax.cond(kv_idx < my_idx, full_b,

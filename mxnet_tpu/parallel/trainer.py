@@ -119,9 +119,12 @@ class ShardedTrainer:
                  shard_optimizer: bool = False,
                  compute_dtype: Optional[str] = None,
                  grad_accum: int = 1,
+                 grad_compression: Optional[str] = None,
+                 grad_bucket_bytes: Optional[int] = None,
                  logger=None):
         from .. import optimizer as opt_mod
         from ..initializer import Uniform
+        from .collectives import DEFAULT_BUCKET_BYTES, check_compression
         self.symbol = symbol
         self.mesh = mesh if mesh is not None else data_parallel_mesh()
         if data_axis is None:
@@ -170,6 +173,18 @@ class ShardedTrainer:
         self.grad_accum = int(grad_accum)
         if self.grad_accum < 1:
             raise MXNetError("grad_accum must be >= 1")
+        # explicit gradient communication: instead of XLA's implicit
+        # all-reduce, the backward runs in a manual shard_map region over
+        # the data axis and gradients are summed through fused flat
+        # buckets (~grad_bucket_bytes each), optionally on a quantized
+        # wire ('int8'/'bf16' — see collectives.psum_compressed).  Off by
+        # default; requires replicated (non-TP) params and a data axis.
+        self.grad_compression = check_compression(grad_compression)
+        self.grad_bucket_bytes = (int(grad_bucket_bytes) if grad_bucket_bytes
+                                  else DEFAULT_BUCKET_BYTES)
+        if grad_compression is not None and self.data_axis is None:
+            raise MXNetError("grad_compression needs a data axis to "
+                             "reduce over; this mesh has none")
         self._bound = False
 
     def _multiproc(self) -> bool:
@@ -313,6 +328,16 @@ class ShardedTrainer:
                 self._wd_mult[n] = 0.0
             else:
                 self._wd_mult[n] = 1.0
+        if self.grad_compression is not None:
+            sharded = [n for n in self._param_names
+                       if any(ax is not None
+                              for ax in self.rules.spec_for(n))]
+            if sharded:
+                raise MXNetError(
+                    "grad_compression runs the backward in a manual "
+                    "region with replicated params; tensor-parallel "
+                    f"rules shard {sharded[:3]}... — use the implicit "
+                    "GSPMD path for TP models")
         self._compile()
         self._bound = True
         return self
@@ -354,6 +379,75 @@ class ShardedTrainer:
                 shard = leaf.sharding.shard_shape(leaf.shape)
                 total += int(np.prod(shard)) * leaf.dtype.itemsize
         return total
+
+    def _explicit_comm_grads(self, base):
+        """Wrap the grad computation in a manual shard_map region over the
+        data axis: per-shard backward, then explicit bucketed (and
+        optionally quantized) psums of the gradients — the comm path this
+        trades for XLA's implicit all-reduce.
+
+        Buckets are emitted last-declared-params-first: their grads exit
+        backward earliest, so their reductions can overlap with the
+        differentiation of earlier layers.  Manual-region semantics
+        caveats (same family as ``SpmdPipelineTrainer``): loss heads
+        should keep the default ``normalization='null'`` (per-shard
+        'batch'/'valid' normalization applies before the cross-shard
+        sum), BatchNorm batch statistics are per-shard with pmean'd
+        running aux, and dropout draws a distinct stream per shard.
+        """
+        from .._compat import shard_map
+        from .collectives import plan_buckets, psum_compressed
+        daxis = self.data_axis
+        comp = self.grad_compression
+        bucket_bytes = self.grad_bucket_bytes
+        param_names = list(self._param_names)
+
+        def reduce_grads(grads):
+            order = [n for n in reversed(param_names) if n in grads]
+            by_dtype: Dict[Any, List[str]] = {}
+            for n in order:
+                by_dtype.setdefault(jnp.dtype(grads[n].dtype), []).append(n)
+            out = dict(grads)
+            for dtype, names in by_dtype.items():
+                names = [n for n in names
+                         if int(np.prod(grads[n].shape, dtype=np.int64)) > 0]
+                if not names:
+                    continue
+                counts = [int(np.prod(grads[n].shape, dtype=np.int64))
+                          for n in names]
+                plan = plan_buckets(counts, dtype.itemsize, bucket_bytes)
+                pieces: Dict[str, List[jax.Array]] = {n: [] for n in names}
+                for bucket in plan:
+                    segs = [grads[names[pi]].ravel()[s0:s1]
+                            for pi, s0, s1 in bucket]
+                    flat = segs[0] if len(segs) == 1 else jnp.concatenate(segs)
+                    red = psum_compressed(flat, daxis, comp)
+                    off = 0
+                    for pi, s0, s1 in bucket:
+                        pieces[names[pi]].append(red[off:off + (s1 - s0)])
+                        off += s1 - s0
+                for n in names:
+                    ps = pieces[n]
+                    flat = ps[0] if len(ps) == 1 else jnp.concatenate(ps)
+                    out[n] = flat.reshape(grads[n].shape)
+            return out
+
+        def body(params, aux, batch, rng):
+            # distinct per-shard stream (dropout etc.); GSPMD gets the
+            # same effect from per-example positions in the global batch
+            rng = jax.random.fold_in(rng, jax.lax.axis_index(daxis))
+            grads, heads, auxu = base(params, aux, batch, rng)
+            grads = reduce_grads(grads)
+            auxu = {k: jax.lax.pmean(v, daxis) for k, v in auxu.items()}
+            return grads, heads, auxu
+
+        kwargs = dict(mesh=self.mesh,
+                      in_specs=(P(), P(), P(self.data_axis), P()),
+                      out_specs=(P(), P(self.data_axis), P()))
+        try:
+            return shard_map(body, check_vma=False, **kwargs)
+        except TypeError:
+            return shard_map(body, check_rep=False, **kwargs)
 
     def _compile(self):
         sym, opt = self.symbol, self.optimizer
@@ -406,6 +500,9 @@ class ShardedTrainer:
             ones = tuple(jnp.ones(h.shape, h.dtype) for h in heads)
             (grads,) = vjp_fn(ones)
             return grads, heads, auxu
+
+        if self.grad_compression is not None and self.data_axis is not None:
+            _grads_and_heads = self._explicit_comm_grads(_grads_and_heads)
 
         def train_step(params, aux, opt_state, batch, lr, t):
             rng = jax.random.fold_in(base_key, t)

@@ -1,0 +1,321 @@
+"""Bucketed gradient all-reduce: fusion, priority, quantization, KVStore
+and trainer integration — on the virtual 8-device CPU mesh.
+
+Exact-arithmetic style where possible (integer-valued f32 tensors make
+collective sums bit-exact); the int8 wire format gets an analytic error
+bound instead.
+"""
+import math
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu.parallel import ShardedTrainer, allreduce_sum, make_mesh
+from mxnet_tpu.parallel.collectives import (DEFAULT_BUCKET_BYTES,
+                                            count_collectives,
+                                            plan_buckets)
+
+
+def _devices(n=None):
+    devs = jax.devices()
+    return devs if n is None else devs[:n]
+
+
+def _mixed_groups(shapes, devs, seed=0, dtype=np.float32, lo=-4, hi=5):
+    """One group per shape: a per-device list of integer-valued tensors
+    (integer values keep f32 sums exact)."""
+    rs = np.random.RandomState(seed)
+    groups = []
+    for shape in shapes:
+        vals = [rs.randint(lo, hi, size=shape).astype(dtype)
+                for _ in devs]
+        groups.append([jax.device_put(jnp.asarray(v), d)
+                       for v, d in zip(vals, devs)])
+    return groups
+
+
+# 22 shapes spanning conv kernels, biases, scalars, embeddings, odd sizes
+MIXED_SHAPES = [(64, 32), (32,), (3, 3, 8, 16), (1,), (128, 64), (17,),
+                (5, 7), (256,), (33, 9), (2, 2, 2), (100,), (64,),
+                (12, 31), (8, 8, 8), (3,), (999,), (48, 16), (7,),
+                (21, 5), (1, 1), (513,), (40, 10)]
+
+
+def test_plan_buckets_exact_ceiling():
+    counts = [int(np.prod(s)) for s in MIXED_SHAPES]
+    itemsize = 4
+    for bucket_bytes in (512, 4096, 1 << 20):
+        plan = plan_buckets(counts, itemsize, bucket_bytes)
+        per_bucket = max(1, bucket_bytes // itemsize)
+        assert len(plan) == math.ceil(sum(counts) / per_bucket)
+        # every element of every tensor is covered exactly once, in order
+        seen = {i: 0 for i in range(len(counts))}
+        for bucket in plan:
+            for idx, start, stop in bucket:
+                assert start == seen[idx]
+                seen[idx] = stop
+        assert all(seen[i] == c for i, c in enumerate(counts))
+
+
+def test_bucketed_f32_bit_identical_and_collective_count():
+    """Acceptance gate: >= 20 mixed-shape f32 grads through small buckets
+    dispatch <= ceil(total_bytes / bucket_bytes) collectives and the
+    reduced values are BIT-identical to per-tensor all-reduce."""
+    devs = _devices()
+    groups = _mixed_groups(MIXED_SHAPES, devs)
+    assert len(groups) >= 20
+    bucket_bytes = 4096
+    total_bytes = sum(int(np.prod(s)) * 4 for s in MIXED_SHAPES)
+
+    with count_collectives() as stats:
+        fused = allreduce_sum(groups, bucket_bytes=bucket_bytes)
+    assert stats.count <= math.ceil(total_bytes / bucket_bytes)
+    assert stats.total_bytes == total_bytes  # nothing dropped or padded
+
+    # reference: one collective per tensor, no fusion
+    ref = [allreduce_sum(g) for g in groups]
+    for f_group, r_group, shape in zip(fused, ref, MIXED_SHAPES):
+        for f, r in zip(f_group, r_group):
+            assert f.shape == tuple(shape)
+            np.testing.assert_array_equal(np.asarray(f), np.asarray(r))
+
+
+def test_bucketed_results_land_on_their_devices():
+    devs = _devices()
+    groups = _mixed_groups([(16, 4), (9,)], devs, seed=2)
+    out = allreduce_sum(groups, bucket_bytes=128)
+    for g in out:
+        for o, d in zip(g, devs):
+            assert next(iter(o.devices())) == d
+
+
+def test_priority_orders_dispatch():
+    """Higher priority => earlier bucket; ties keep submission order."""
+    devs = _devices(2)
+    shapes = [(8,)] * 6
+    groups = _mixed_groups(shapes, devs, seed=3)
+    priorities = [0, 5, 5, -1, 9, 0]
+    # one tensor per bucket: 8 elems * 4 B
+    with count_collectives() as stats:
+        allreduce_sum(groups, priorities=priorities, bucket_bytes=32)
+    dispatched = [idx for r in stats.records for idx in r["tensor_indices"]]
+    assert dispatched == [4, 1, 2, 0, 5, 3]
+
+
+def test_int8_within_analytic_bound():
+    devs = _devices()
+    n = len(devs)
+    rs = np.random.RandomState(7)
+    vals = [rs.randn(64, 32).astype(np.float32) for _ in devs]
+    groups = [[jax.device_put(jnp.asarray(v), d)
+               for v, d in zip(vals, devs)]]
+    out = allreduce_sum(groups, compression="int8")[0][0]
+    exact = np.sum(vals, axis=0)
+    # shared scale = global absmax / 127; each shard rounds to half a
+    # step, n shards sum the error
+    scale = max(np.abs(v).max() for v in vals) / 127.0
+    err = np.abs(np.asarray(out) - exact).max()
+    assert err <= n * scale / 2 + 1e-6
+    # small integers below half the quantization range survive exactly
+    small = _mixed_groups([(32,)], devs, seed=8, lo=-40, hi=41)
+    exact_small = allreduce_sum(small)[0][0]
+    q_small = allreduce_sum(small, compression="int8")[0][0]
+    np.testing.assert_allclose(np.asarray(q_small), np.asarray(exact_small),
+                               atol=len(devs) * 0.5)
+
+
+def test_bf16_compression_roundtrip():
+    devs = _devices()
+    # integer values in bf16's exact range: the cast wire is lossless
+    groups = _mixed_groups([(16, 8)], devs, seed=9, lo=-8, hi=9)
+    exact = allreduce_sum(groups)[0][0]
+    out = allreduce_sum(groups, compression="bf16")[0][0]
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(exact))
+
+
+def test_mixed_dtypes_and_zero_size():
+    """f32 + bf16 + int32 + a zero-size tensor in one call: dtype classes
+    bucket separately, non-floats skip quantization, empties pass through."""
+    devs = _devices(4)
+    rs = np.random.RandomState(11)
+    specs = [((8, 3), np.float32), ((7,), jnp.bfloat16), ((5, 2), np.int32),
+             ((0,), np.float32), ((33,), np.float32)]
+    groups = []
+    for shape, dtype in specs:
+        vals = [rs.randint(-3, 4, size=shape) for _ in devs]
+        groups.append([jax.device_put(jnp.asarray(v, dtype=dtype), d)
+                       for v, d in zip(vals, devs)])
+    # int8's shared scale (absmax/127) does not divide small integers, so
+    # float groups carry up to ndev * scale/2 rounding; everything else
+    # (non-floats, bf16-exact ints, empties) must come back exact
+    int8_atol = len(devs) * (3.0 / 127.0) / 2 + 1e-6
+    for compression in (None, "int8", "bf16"):
+        out = allreduce_sum(groups, compression=compression,
+                            bucket_bytes=64)
+        for g_in, g_out, (shape, dtype) in zip(groups, out, specs):
+            expect = np.sum([np.asarray(a, dtype=np.float64) for a in g_in],
+                            axis=0)
+            lossy = (compression == "int8"
+                     and jnp.issubdtype(jnp.dtype(dtype), jnp.floating))
+            for o in g_out:
+                assert o.shape == tuple(shape)
+                assert o.dtype == jnp.dtype(dtype)
+                got = np.asarray(o, dtype=np.float64)
+                if lossy:
+                    np.testing.assert_allclose(got, expect, atol=int8_atol)
+                else:
+                    np.testing.assert_array_equal(got, expect)
+
+
+def test_unknown_compression_rejected():
+    with pytest.raises(mx.base.MXNetError):
+        allreduce_sum([jnp.ones(3)], compression="fp4")
+    with pytest.raises(mx.base.MXNetError):
+        mx.kvstore.create("local", compression="fp4")
+
+
+# ---------------------------------------------------------------------------
+# KVStore integration
+
+def test_kvstore_bucketed_push_fuses_collectives():
+    """Multiple small pushes flush as fused buckets, exact sums, and the
+    updater still sees keys in push order."""
+    kv = mx.kvstore.create("local", bucket_bytes=4096)
+    assert kv.compression is None  # off by default
+    devs = _devices(4)
+    shapes = {1: (3, 2), 2: (17,), 3: (5, 5)}
+    for k, shape in shapes.items():
+        kv.init(k, mx.nd.zeros(shape))
+    with count_collectives() as stats:
+        for k, shape in shapes.items():
+            vals = [mx.nd.NDArray(np.full(shape, i + 1, np.float32),
+                                  ctx=mx.cpu(i))
+                    for i in range(len(devs))]
+            kv.push(k, vals)
+        out = mx.nd.zeros(shapes[3])
+        kv.pull(3, out=out)  # forces the flush
+    np.testing.assert_array_equal(out.asnumpy(), 10.0)
+    total = sum(int(np.prod(s)) * 4 for s in shapes.values())
+    assert stats.count <= math.ceil(total / 4096)
+    for k, shape in list(shapes.items())[:2]:
+        out = mx.nd.zeros(shape)
+        kv.pull(k, out=out)
+        np.testing.assert_array_equal(out.asnumpy(), 10.0)
+
+
+def test_kvstore_int8_compression_smoke():
+    kv = mx.kvstore.create("local", compression="int8")
+    assert kv.compression == "int8"
+    devs = _devices(4)
+    shape = (6, 4)
+    kv.init(9, mx.nd.zeros(shape))
+    # values well inside the int8 range quantize exactly
+    vals = [mx.nd.NDArray(np.full(shape, i + 1, np.float32),
+                          ctx=mx.cpu(i))
+            for i in range(len(devs))]
+    kv.push(9, vals)
+    out = mx.nd.zeros(shape)
+    kv.pull(9, out=out)
+    np.testing.assert_allclose(out.asnumpy(), 10.0, atol=len(devs) * 0.5)
+
+
+def test_kvstore_priority_flush_order():
+    kv = mx.kvstore.create("local")
+    devs = _devices(2)
+    for k in (1, 2, 3):
+        kv.init(k, mx.nd.zeros((4,)))
+    with count_collectives() as stats:
+        for k, pr in ((1, 0), (2, 10), (3, 5)):
+            vals = [mx.nd.NDArray(np.full((4,), i + 1, np.float32),
+                                  ctx=mx.cpu(i))
+                    for i in range(len(devs))]
+            kv.push(k, vals, priority=pr)
+        kv.barrier()
+    # one bucket (all three fit): pieces laid out high priority first
+    order = [i for r in stats.records for i in r["tensor_indices"]]
+    assert order == [1, 2, 0]
+
+
+# ---------------------------------------------------------------------------
+# ShardedTrainer integration
+
+def _mlp():
+    data = mx.symbol.Variable("data")
+    fc1 = mx.symbol.FullyConnected(data=data, name="fc1", num_hidden=16)
+    act = mx.symbol.Activation(data=fc1, name="relu1", act_type="relu")
+    fc2 = mx.symbol.FullyConnected(data=act, name="fc2", num_hidden=4)
+    return mx.symbol.SoftmaxOutput(data=fc2, name="softmax")
+
+
+def _toy_batch(n=32, seed=0):
+    rs = np.random.RandomState(seed)
+    x = rs.randn(n, 8).astype(np.float32)
+    w = rs.randn(8, 4).astype(np.float32)
+    y = np.argmax(x @ w, axis=1).astype(np.float32)
+    return x, y
+
+
+def _fit_acc(grad_compression):
+    sym = _mlp()
+    x, y = _toy_batch(256, seed=3)
+    train = mx.io.NDArrayIter(x, y, batch_size=64, shuffle=False)
+    mx.random.seed(5)
+    tr = ShardedTrainer(sym, optimizer="sgd",
+                        optimizer_params={"learning_rate": 0.3,
+                                          "momentum": 0.9},
+                        mesh=make_mesh({"data": -1}),
+                        grad_compression=grad_compression)
+    assert tr.grad_compression == grad_compression
+    tr.bind({"data": (64, 8)}, {"softmax_label": (64,)})
+    tr.fit(train, num_epoch=10)
+    m = tr.score(mx.io.NDArrayIter(x, y, batch_size=64), "acc")
+    return m.get()[1]
+
+
+def test_trainer_default_is_uncompressed():
+    tr = ShardedTrainer(_mlp(), optimizer="sgd",
+                        mesh=make_mesh({"data": -1}))
+    assert tr.grad_compression is None
+
+
+def test_trainer_int8_grads_converge():
+    """Convergence-style gate: int8 gradient all-reduce reaches the same
+    accuracy bar as exact f32 on the toy problem."""
+    acc_f32 = _fit_acc(None)
+    acc_int8 = _fit_acc("int8")
+    assert acc_f32 > 0.7
+    assert acc_int8 > 0.7
+    assert acc_int8 >= acc_f32 - 0.05
+
+
+def test_trainer_bf16_grads_match_closely():
+    sym = _mlp()
+    x, y = _toy_batch(32)
+
+    def run(grad_compression):
+        mx.random.seed(7)
+        tr = ShardedTrainer(sym, optimizer="sgd",
+                            optimizer_params={"learning_rate": 0.1},
+                            mesh=make_mesh({"data": -1}),
+                            grad_compression=grad_compression)
+        tr.bind({"data": (32, 8)}, {"softmax_label": (32,)})
+        for _ in range(3):
+            tr.step({"data": x, "softmax_label": y})
+        return tr.get_params()[0]
+
+    ref = run(None)
+    bf = run("bf16")
+    for n in ref:
+        np.testing.assert_allclose(ref[n].asnumpy(), bf[n].asnumpy(),
+                                   rtol=0.05, atol=5e-3)
+
+
+def test_trainer_compression_requires_data_axis():
+    with pytest.raises(mx.base.MXNetError):
+        ShardedTrainer(_mlp(), optimizer="sgd",
+                       mesh=make_mesh({"model": -1}),
+                       grad_compression="int8")

@@ -12,7 +12,8 @@ import pytest
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+
+from mxnet_tpu._compat import shard_map
 
 from mxnet_tpu.parallel.ring_attention import (_ring_flash,
                                                local_attention,
