@@ -1,16 +1,22 @@
 """Headline benchmarks with MFU accounting.
 
-Default run prints TWO JSON lines and the driver parses the LAST:
+Default run prints THREE JSON lines and the driver parses the LAST:
 
-1. Inception-BN at ImageNet shape (224x224, batch 256, bf16 AMP) —
+1. Inception-BN at ImageNet shape (224x224, batch 128, bf16 AMP) —
    vs_baseline is the epoch-time-equivalent ratio against the
    reference's best published single-GPU ImageNet epoch (10,666 s,
    example/image-classification/README.md:251-255, BASELINE.md rows
    2-3);
-2. ResNet-50 at ImageNet shape (224x224, batch 256, bf16 AMP) — the
+2. Transformer-LM (6L d512, seq 2048, batch 8, loss-only head) —
+   tokens/s with dense-equivalent MFU (the r5 best-MFU config);
+3. ResNet-50 at ImageNet shape (224x224, batch 256, bf16 AMP) — the
    BASELINE north-star config, reported with MFU; vs_baseline is the
    same epoch-time-equivalent ratio (the reference has no ResNet-50
    ImageNet table).
+
+``--profile-step`` additionally emits a per-phase step-overhead
+attribution (host pre-step / dispatch / device compute / fetch) for each
+benched network — see docs/perf.md "step overhead attribution".
 
 The CIFAR-10 inception-bn-28-small headline (842 img/s on 1x GTX 980,
 BASELINE.md row 1) runs via --network inception-bn-28-small.
@@ -187,6 +193,17 @@ def report(metric, value, unit, vs_baseline, per_step, dispatch, compile_s,
     return rec
 
 
+def _emit_step_profile(trainer, host_feeds, steps, title):
+    """--profile-step: per-phase attribution table (human) + one JSON line
+    (machine; tools/parse_log.py --diff-profile consumes these)."""
+    from mxnet_tpu import profiler
+    prof = profiler.profile_step(trainer, host_feeds, steps=steps)
+    print(profiler.format_step_profile(prof, title))
+    print(json.dumps({"step_profile": {k: round(v, 4) for k, v in prof.items()},
+                      "metric": title}))
+    return prof
+
+
 def _make_trainer(sym, precision, compute_dtype, optimizer="sgd",
                   optimizer_params=None, grad_compression=None):
     import jax
@@ -285,12 +302,16 @@ def bench_image(args, network=None, image_shape=None, batch=None,
     trainer.bind(data_shapes={"data": (batch,) + image},
                  label_shapes={"softmax_label": (batch,)})
     rng = np.random.RandomState(0)
-    feeds = [trainer.place_batch(
+    host_feeds = [
         {"data": rng.rand(batch, *image).astype(np.float32),
          "softmax_label": rng.randint(0, num_classes, (batch,))
-         .astype(np.float32)})
+         .astype(np.float32)}
         for _ in range(2)]
+    feeds = [trainer.place_batch(f) for f in host_feeds]
     per_step, dispatch, compile_s, flops = measure(trainer, feeds, args.steps)
+    if getattr(args, "profile_step", False):
+        _emit_step_profile(trainer, host_feeds, args.steps,
+                           f"{network} batch {batch}")
     img_s = batch / per_step
     if network == "inception-bn-28-small":
         vs = round(img_s / BASELINE_IMG_S, 3)
@@ -312,13 +333,17 @@ def bench_image(args, network=None, image_shape=None, batch=None,
         img_s, "img/s", vs, per_step, dispatch, compile_s, flops, prec)
 
 
-def bench_lm(args):
+def bench_lm(args, batch=None, seq_len=None, head_loss=None):
     """Transformer-LM training throughput in tokens/s (the long-context
-    flagship; no 2016-reference analog, so vs_baseline is null)."""
+    flagship; no 2016-reference analog, so vs_baseline is null).
+    ``batch``/``seq_len``/``head_loss`` override the CLI args so the
+    default suite can pin its driver-captured row's config."""
     import jax
     from mxnet_tpu import models
 
-    b, l = args.batch_size, args.seq_len
+    b = batch or args.batch_size
+    l = seq_len or args.seq_len
+    loss_head = args.head_loss if head_loss is None else head_loss
     vocab = args.vocab
     # ONE kwargs dict builds both the timed symbol and the dense
     # FLOPs twin — they must be the same model up to attn_block_size
@@ -326,7 +351,7 @@ def bench_lm(args):
         vocab_size=vocab, num_layers=args.num_layers,
         d_model=args.d_model, heads=max(1, args.d_model // 64),
         batch_size=b, seq_len=l, remat=args.remat,
-        head_same_dtype=args.head_bf16, loss_head=args.head_loss)
+        head_same_dtype=args.head_bf16, loss_head=loss_head)
     sym = models.get_symbol("transformer-lm", **lm_kwargs)
     trainer = _make_trainer(sym, args.precision, args.compute_dtype,
                             optimizer="adam",
@@ -335,10 +360,11 @@ def bench_lm(args):
     trainer.bind(data_shapes={"data": (b, l)},
                  label_shapes={"softmax_label": (b, l)})
     rng = np.random.RandomState(0)
-    feeds = [trainer.place_batch(
+    host_feeds = [
         {"data": rng.randint(0, vocab, (b, l)).astype(np.float32),
-         "softmax_label": rng.randint(0, vocab, (b, l)).astype(np.float32)})
+         "softmax_label": rng.randint(0, vocab, (b, l)).astype(np.float32)}
         for _ in range(2)]
+    feeds = [trainer.place_batch(f) for f in host_feeds]
     # MFU accounting: flops come from a DENSE-attention twin of the
     # same model (attn_block_size=-1) — the dense-equivalent convention
     # (full QK^T/PV einsums, no causal discount), stable across kernel
@@ -353,6 +379,9 @@ def bench_lm(args):
     per_step, dispatch, compile_s, _ = measure(trainer, feeds, args.steps,
                                                with_flops=False)
     flops = _step_flops(trainer, feeds[0], flops_symbol=dense_sym)
+    if getattr(args, "profile_step", False):
+        _emit_step_profile(trainer, host_feeds, args.steps,
+                           f"transformer-lm seq{l} batch {b}")
     tok_s = b * l / per_step
     prec = args.compute_dtype or args.precision
     return report(
@@ -403,6 +432,10 @@ def main():
                     choices=("none", "int8", "bf16"),
                     help="quantized gradient all-reduce wire format "
                     "(dp meshes; see docs/perf.md gradient communication)")
+    ap.add_argument("--profile-step", action="store_true",
+                    help="per-phase step-overhead attribution (host "
+                    "pre-step / dispatch / device compute / fetch) for "
+                    "each benched network; see docs/perf.md")
     args = ap.parse_args()
     if args.compute_dtype == "none":
         args.compute_dtype = None
@@ -426,13 +459,17 @@ def main():
     if (args.batch_size, args.image_shape, args.num_classes) != (256, "3,28,28", 10):
         print("note: default suite uses fixed configs; pass --network to "
               "apply --batch-size/--image-shape/--num-classes", file=sys.stderr)
-    # two rows only — the suite must finish inside the driver's window.
-    # Other configs run via --network; flash-attention LM rows are
+    # three rows — the suite must still finish inside the driver's window.
+    # Other configs run via --network; flash-attention 32k LM rows are
     # recorded in docs/perf.md + README.
     # batch 128 is inception-bn's measured sweet spot (5,344 img/s /
-    # 0.311 MFU vs 4,846 / 0.282 at 256); resnet's is 256 (r4 sweep)
+    # 0.311 MFU vs 4,846 / 0.282 at 256); resnet's is 256 (r4 sweep);
+    # the LM row pins the r5 best-MFU config (seq 2048, batch 8,
+    # loss-only head — 0.425 dense-equivalent MFU on v5e) so the
+    # tokens/s + MFU numbers are driver-captured, not builder-run
     bench_image(args, network="inception-bn", image_shape="3,224,224",
                 batch=128, num_classes=1000)
+    bench_lm(args, batch=8, seq_len=2048, head_loss=True)
     bench_image(args, network="resnet", image_shape="3,224,224",
                 batch=256, num_classes=1000)
     return 0

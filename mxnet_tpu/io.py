@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import gzip
 import os
+import queue
 import struct
 import threading
 from collections import namedtuple
@@ -25,7 +26,8 @@ from .context import Context
 from .ndarray import NDArray, array as nd_array
 
 __all__ = ["DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
-           "PrefetchingIter", "CSVIter", "MNISTIter", "DataDesc"]
+           "PrefetchingIter", "DevicePrefetchIter", "CSVIter", "MNISTIter",
+           "DataDesc"]
 
 
 DataDesc = namedtuple("DataDesc", ["name", "shape"])
@@ -356,6 +358,140 @@ class PrefetchingIter(DataIter):
 
     def getpad(self):
         return self.current_batch.pad
+
+
+class DevicePrefetchIter(DataIter):
+    """Async double-buffered *device placement* prefetcher.
+
+    While the compiled step for batch *k* runs on the accelerator, a
+    background thread pulls batch *k+1* from ``data_iter`` and runs
+    ``place_fn`` on it — typically a sharded, committed ``device_put``
+    (``ShardedTrainer.place_batch``) or a per-device staging split
+    (``DataParallelExecutorGroup.stage_data_batch``).  ``device_put`` only
+    *enqueues* the host→device transfer, so the copy itself overlaps with
+    device compute and the training loop never waits on input placement.
+
+    Yields whatever ``place_fn`` returned (the *staged* batch); the raw
+    host batch is kept on :attr:`current_source` for callers that need
+    ``batch.label``/``batch.pad``.  Exceptions raised by the inner iterator
+    or ``place_fn`` propagate from :meth:`next` on the consumer thread.
+    """
+
+    _END = ("end", None, None)
+
+    def __init__(self, data_iter: DataIter, place_fn=None, depth: int = 2):
+        super().__init__()
+        if depth < 1:
+            raise MXNetError("DevicePrefetchIter depth must be >= 1")
+        self.data_iter = data_iter
+        self.place_fn = place_fn if place_fn is not None else (lambda b: b)
+        self.depth = depth
+        self.batch_size = getattr(data_iter, "batch_size", 0)
+        self.current_batch = None   # staged batch (place_fn output)
+        self.current_source = None  # raw host batch from data_iter
+        self._queue: Optional["queue.Queue"] = None
+        self._thread: Optional[threading.Thread] = None
+        self._stop: Optional[threading.Event] = None
+
+    @property
+    def provide_data(self):
+        return self.data_iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self.data_iter.provide_label
+
+    def _start(self) -> None:
+        q: "queue.Queue" = queue.Queue(maxsize=self.depth)
+        stop = threading.Event()
+        inner, place = self.data_iter, self.place_fn
+
+        def put(item):
+            # bounded put that stays responsive to shutdown
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.05)
+                    return
+                except queue.Full:
+                    continue
+
+        def worker():
+            try:
+                while not stop.is_set():
+                    try:
+                        batch = inner.next()
+                    except StopIteration:
+                        put(DevicePrefetchIter._END)
+                        return
+                    put(("batch", place(batch), batch))
+            except BaseException as exc:  # propagate to the consumer
+                put(("error", exc, None))
+
+        self._queue = q
+        self._stop = stop
+        self._thread = threading.Thread(target=worker, daemon=True)
+        self._thread.start()
+
+    def _shutdown(self) -> None:
+        if self._thread is None:
+            return
+        self._stop.set()
+        # unblock a producer stuck on a full queue
+        try:
+            while True:
+                self._queue.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=5.0)
+        self._queue = None
+        self._thread = None
+        self._stop = None
+
+    def __del__(self):
+        try:
+            self._shutdown()
+        except Exception:
+            pass
+
+    def reset(self):
+        self._shutdown()
+        self.current_batch = None
+        self.current_source = None
+        self.data_iter.reset()
+
+    def next(self):
+        if self._thread is None:
+            self._start()
+        kind, staged, source = self._queue.get()
+        if kind == "end":
+            # keep the sentinel so repeated next() keeps raising
+            self._queue.put(DevicePrefetchIter._END)
+            raise StopIteration
+        if kind == "error":
+            self._queue.put(("error", staged, None))
+            raise staged
+        self.current_batch = staged
+        self.current_source = source
+        return staged
+
+    def iter_next(self):
+        try:
+            self.next()
+            return True
+        except StopIteration:
+            return False
+
+    def getdata(self):
+        return self.current_source.data
+
+    def getlabel(self):
+        return self.current_source.label
+
+    def getindex(self):
+        return getattr(self.current_source, "index", None)
+
+    def getpad(self):
+        return getattr(self.current_source, "pad", 0)
 
 
 class CSVIter(NDArrayIter):

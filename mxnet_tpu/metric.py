@@ -10,7 +10,7 @@ from .ndarray import NDArray
 
 __all__ = ["EvalMetric", "Accuracy", "TopKAccuracy", "F1", "MAE", "MSE",
            "RMSE", "CrossEntropy", "CustomMetric", "CompositeEvalMetric",
-           "create", "np"]
+           "AsyncMetric", "create", "np"]
 
 
 def _as_numpy(x) -> numpy.ndarray:
@@ -254,6 +254,83 @@ class CompositeEvalMetric(EvalMetric):
             names.append(result[0])
             results.append(result[1])
         return names, results
+
+
+class AsyncMetric(EvalMetric):
+    """Deferred-fetch facade over any :class:`EvalMetric`.
+
+    ``update`` only snapshots *device references* to labels/predictions —
+    no ``asnumpy`` and therefore no device→host sync on the training hot
+    loop (the per-batch fetch in the plain metrics is the analog of an
+    ``Engine::WaitForVar`` between every step).  The buffered batches are
+    replayed into the wrapped metric every ``period`` updates (sized so
+    at most ~64 MB of device output is held alive) or whenever a value is
+    actually requested via ``get``/``get_name_value``.
+
+    Safe with buffer donation as configured in this codebase: executors
+    create fresh output NDArrays per batch and neither outputs nor labels
+    are ever passed through a ``donate_argnums`` position, so the buffered
+    references stay live until replay.
+    """
+
+    _MAX_BUFFER_BYTES = 64 << 20
+
+    def __init__(self, inner: Union[str, EvalMetric], period: Optional[int] = None):
+        # deliberately no super().__init__: state lives in `inner`
+        self.inner = inner if isinstance(inner, EvalMetric) else create(inner)
+        self.name = self.inner.name
+        self.num = getattr(self.inner, "num", None)
+        self._period = period
+        self._buf: List = []
+
+    @staticmethod
+    def _snap(x):
+        # NDArray -> jax value (async dispatch at most, e.g. a view slice);
+        # anything else is already host data
+        return x.data if isinstance(x, NDArray) else x
+
+    def update(self, labels, preds):
+        labels = [self._snap(x) for x in (labels or [])]
+        preds = [self._snap(x) for x in preds]
+        self._buf.append((labels, preds))
+        if self._period is None:
+            nbytes = sum(a.size * a.dtype.itemsize for a in labels + preds
+                         if hasattr(a, "dtype"))
+            self._period = max(1, min(32, self._MAX_BUFFER_BYTES // max(1, nbytes)))
+        if len(self._buf) >= self._period:
+            self._drain()
+
+    def _drain(self):
+        buf, self._buf = self._buf, []
+        for labels, preds in buf:
+            self.inner.update([numpy.asarray(x) for x in labels],
+                              [numpy.asarray(x) for x in preds])
+
+    def reset(self):
+        self._buf = []
+        self.inner.reset()
+
+    def get(self):
+        self._drain()
+        return self.inner.get()
+
+    def get_name_value(self):
+        self._drain()
+        return self.inner.get_name_value()
+
+    def get_metric(self, index: int):
+        self._drain()
+        return self.inner.get_metric(index)
+
+    @property
+    def sum_metric(self):
+        self._drain()
+        return self.inner.sum_metric
+
+    @property
+    def num_inst(self):
+        self._drain()
+        return self.inner.num_inst
 
 
 def np(numpy_feval: Callable, name: Optional[str] = None,

@@ -228,6 +228,11 @@ class BaseModule:
             validation_metric = eval_metric
         if not isinstance(eval_metric, metric_mod.EvalMetric):
             eval_metric = metric_mod.create(eval_metric)
+        # host-overhead elimination: pre-place batch k+1 while step k runs,
+        # and keep the train metric's device→host fetches off the hot loop
+        stage_fn = getattr(self, "stage_batch", None)
+        train_data = mx_io.DevicePrefetchIter(train_data, place_fn=stage_fn)
+        eval_metric = metric_mod.AsyncMetric(eval_metric)
 
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()

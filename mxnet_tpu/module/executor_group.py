@@ -173,8 +173,41 @@ class DataParallelExecutorGroup:
                 total = total + p.astype(total.dtype)
             dst._write((total / len(block)).astype(dst.dtype))
 
+    @property
+    def _group_key(self):
+        return (tuple((s.start, s.stop) for s in self.slices),
+                tuple(str(c) for c in self.contexts))
+
+    def stage_data_batch(self, data_batch):
+        """Pre-place a batch's per-device slices (async ``device_put``) so
+        :meth:`load_data_batch` degenerates to a buffer-reference swap.
+        Safe to call from a prefetch thread while the previous step runs:
+        executors snapshot their argument buffers at ``forward``."""
+        from ..executor_manager import StagedBatch
+        if getattr(data_batch, "parts_data", None) is not None:
+            return data_batch
+        def stage(srcs):
+            parts = []
+            for src in srcs or []:
+                parts.append([src.slice(sl.start, sl.stop).copyto(ctxi)
+                              for sl, ctxi in zip(self.slices, self.contexts)])
+            return parts
+        return StagedBatch(data_batch, self._group_key,
+                           stage(data_batch.data), stage(data_batch.label))
+
     def load_data_batch(self, data_batch) -> None:
-        from ..executor_manager import _load_general
+        from ..executor_manager import _load_general, StagedBatch
+        if (isinstance(data_batch, StagedBatch)
+                and data_batch.group_key == self._group_key):
+            for parts, d_targets in zip(data_batch.parts_data, self.data_arrays):
+                for part, (_sl, d_dst) in zip(parts, d_targets):
+                    d_dst._write(part.data)
+            if self.label_arrays and data_batch.parts_label:
+                for parts, d_targets in zip(data_batch.parts_label,
+                                            self.label_arrays):
+                    for part, (_sl, d_dst) in zip(parts, d_targets):
+                        d_dst._write(part.data)
+            return
         _load_general(data_batch.data, self.data_arrays)
         if self.label_arrays and data_batch.label:
             _load_general(data_batch.label, self.label_arrays)

@@ -261,6 +261,14 @@ class Module(BaseModule):
         self._updater = shared_module._updater
         self.optimizer_initialized = True
 
+    def stage_batch(self, data_batch):
+        """Pre-place a batch's per-device slices ahead of the step (the
+        :class:`~mxnet_tpu.io.DevicePrefetchIter` hook used by ``fit``);
+        no-op passthrough until bound."""
+        if not self.binded:
+            return data_batch
+        return self._exec_group.stage_data_batch(data_batch)
+
     def forward(self, data_batch, is_train=None):
         assert self.binded and self.params_initialized
         self._exec_group.forward(data_batch, is_train)

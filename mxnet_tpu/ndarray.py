@@ -34,7 +34,23 @@ from .ops.registry import OP_REGISTRY, OpContext, get_op
 __all__ = [
     "NDArray", "zeros", "ones", "full", "empty", "array", "arange",
     "concatenate", "save", "load", "imperative_invoke", "waitall",
+    "note_donation",
 ]
+
+# Most recent donating dispatch, recorded by the code that passes buffers
+# through a ``donate_argnums`` jit (ShardedTrainer.step, Optimizer.update).
+# Used to name the culprit when someone later reads a deleted buffer.
+_LAST_DONATION: Optional[str] = None
+
+
+def note_donation(owner: str) -> None:
+    """Record that `owner` just donated buffers to a compiled step.
+
+    Reading a donated buffer afterwards raises a RuntimeError that names
+    this owner instead of surfacing a cryptic XLA "buffer deleted" error.
+    """
+    global _LAST_DONATION
+    _LAST_DONATION = owner
 
 _DTYPE_ALIASES = {
     "float32": np.float32, "float64": np.float64, "float16": np.float16,
@@ -59,11 +75,14 @@ class _Chunk:
     version chain in ``threaded_engine.h:71``.
     """
 
-    __slots__ = ("data", "version")
+    __slots__ = ("data", "version", "donated_by")
 
     def __init__(self, data: jax.Array):
         self.data = data
         self.version = 0
+        # set when this chunk's buffer was handed to a donate_argnums jit;
+        # names the donating step in the asnumpy/asscalar guard message
+        self.donated_by: Optional[str] = None
 
     def write(self, new_data: jax.Array) -> None:
         self.data = new_data
@@ -232,11 +251,33 @@ class NDArray:
     # Synchronization / transfer
     # ------------------------------------------------------------------
 
+    def mark_donated(self, owner: str) -> None:
+        """Tag this array's storage as donated by `owner` (a compiled step
+        with ``donate_argnums``), so later reads raise a descriptive error."""
+        self._chunk.donated_by = owner
+        note_donation(owner)
+
+    def _check_live(self) -> None:
+        buf = self._chunk.data
+        if getattr(buf, "is_deleted", lambda: False)():
+            owner = self._chunk.donated_by or _LAST_DONATION
+            hint = (f" its buffer was donated by {owner}." if owner
+                    else " its buffer was deleted (most likely donated to a"
+                         " donate_argnums compiled step).")
+            raise RuntimeError(
+                f"cannot read NDArray of shape {self._shape}:{hint} "
+                "Donated storage is consumed in place by XLA; copy the value "
+                "(e.g. .copy()/asnumpy()) before the donating step runs, or "
+                "read the trainer's current parameters instead of a stale "
+                "handle.")
+
     def wait_to_read(self) -> None:
         """Block until the value is computed (Engine::WaitForVar analog)."""
+        self._check_live()
         jax.block_until_ready(self._chunk.data)
 
     def asnumpy(self) -> np.ndarray:
+        self._check_live()
         return np.asarray(self.data)
 
     def asscalar(self):

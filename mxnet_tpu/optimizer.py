@@ -23,6 +23,7 @@ import jax.numpy as jnp
 
 from .base import MXNetError, Registry
 from .lr_scheduler import LRScheduler
+from . import ndarray as ndarray_mod
 from .ndarray import NDArray
 
 __all__ = ["Optimizer", "SGD", "NAG", "SGLD", "ccSGD", "Adam", "AdamW",
@@ -131,6 +132,8 @@ class Optimizer:
     def __getstate__(self):
         state = self.__dict__.copy()
         state["sym"] = None
+        # device-buffer ownership map is process-local bookkeeping
+        state.pop("_owned_state", None)
         return state
 
     def __setstate__(self, state):
@@ -200,11 +203,20 @@ class Optimizer:
         raise NotImplementedError
 
     @classmethod
-    def _jitted_step(cls):
-        fn = Optimizer._JIT_STEPS.get(cls)
+    def _jitted_step(cls, donate: bool = False):
+        key = (cls, donate)
+        fn = Optimizer._JIT_STEPS.get(key)
         if fn is None:
-            fn = jax.jit(cls._functional_step)
-            Optimizer._JIT_STEPS[cls] = fn
+            # steady-state variant donates the optimizer-state buffers so
+            # XLA updates them in place instead of allocating fresh outputs
+            # each step. Weights are never donated on this path: same-device
+            # copyto/get_params share weight buffers with user-held param
+            # dicts (checkpointing reads them), so donating would delete
+            # buffers the caller still owns. State buffers live only inside
+            # the updater loop.
+            fn = jax.jit(cls._functional_step,
+                         donate_argnums=(3,) if donate else ())
+            Optimizer._JIT_STEPS[key] = fn
         return fn
 
     # --- state + update ------------------------------------------------
@@ -222,6 +234,21 @@ class Optimizer:
 
         return conv(sval)
 
+    def _state_donation_safe(self, index, state_vals) -> bool:
+        """True iff every state leaf buffer is one this optimizer produced
+        on the previous update for `index` — i.e. exclusively owned by the
+        update loop, so handing it to a donating jit cannot delete storage
+        someone else (set_states, a checkpoint restore) still references."""
+        owned = getattr(self, "_owned_state", None)
+        if owned is None:
+            return False
+        prev = owned.get(index)
+        if prev is None:
+            return False
+        leaves = jax.tree_util.tree_leaves(state_vals)
+        return len(leaves) == len(prev) and all(
+            a is b for a, b in zip(leaves, prev))
+
     def update(self, index, weight: NDArray, grad: NDArray, state) -> None:
         """One fused XLA dispatch: rescale/clip + state + weight update."""
         lr = self._get_lr(index)
@@ -232,11 +259,20 @@ class Optimizer:
         if self._needs_rng:
             from . import random as _random
             rng = _random._next_key()
-        new_w, new_s = self._jitted_step()(
-            self._hyper(), weight.data, grad.data, _state_data(state),
+        state_vals = _state_data(state)
+        donate = state is not None and self._state_donation_safe(index, state_vals)
+        if donate:
+            ndarray_mod.note_donation(
+                f"{type(self).__name__}.update(index={index}, t={t})")
+        new_w, new_s = self._jitted_step(donate)(
+            self._hyper(), weight.data, grad.data, state_vals,
             lr, wd, t, rng)
         weight._write(new_w)
         _state_writeback(state, new_s)
+        if state is not None:
+            if getattr(self, "_owned_state", None) is None:
+                self._owned_state: Dict[Any, Any] = {}
+            self._owned_state[index] = jax.tree_util.tree_leaves(new_s)
 
 
 @register

@@ -36,6 +36,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..base import MXNetError
 from ..graph_eval import eval_symbol
 from ..context import Context, cpu
+from .. import ndarray as nd_mod
 from ..ndarray import NDArray, array as nd_array
 from .mesh import (DATA_AXIS, SEQ_AXIS, batch_sharding, data_parallel_mesh,
                    default_mesh, replicated)
@@ -186,6 +187,16 @@ class ShardedTrainer:
             raise MXNetError("grad_compression needs a data axis to "
                              "reduce over; this mesh has none")
         self._bound = False
+        # steady-state instrumentation (same contract as pipeline_spmd):
+        # dispatch_count counts compiled-program dispatches; trace_counts
+        # counts how often each program (re)traced — exactly 1 per program
+        # once shapes/dtypes are static.  strict_retrace turns a signature
+        # change on the train path into a hard error instead of a warning.
+        self.dispatch_count = 0
+        self.trace_counts: Dict[str, int] = {"train": 0, "train_acc": 0,
+                                             "eval": 0}
+        self.strict_retrace = False
+        self._train_sigs: List[Tuple] = []
 
     def _multiproc(self) -> bool:
         if not hasattr(self, "_multiproc_cached"):
@@ -603,11 +614,23 @@ class ShardedTrainer:
         o_shard = {n: jax.tree.map(
             lambda _, _s=NamedSharding(self.mesh, self._zero_specs[n]): _s,
             self._opt_state[n]) for n in param_names}
+        # retrace guards: the counter bump is a host side effect, so it
+        # fires only while jax traces the function — in steady state each
+        # program's count stays at exactly 1 (asserted by
+        # assert_steady_state / tests/test_step_overhead.py)
+        def _counted(kind, fn):
+            def wrapped(*args):
+                self.trace_counts[kind] += 1
+                return fn(*args)
+            return wrapped
+
+        self.trace_counts = {"train": 0, "train_acc": 0, "eval": 0}
+        self._train_sigs = []
         self._train_step = jax.jit(
-            train_step,
+            _counted("train", train_step),
             out_shardings=(p_shard, a_shard, o_shard, None),
             donate_argnums=(0, 1, 2))
-        self._eval_step = jax.jit(eval_step)
+        self._eval_step = jax.jit(_counted("eval", eval_step))
 
         # fit()'s fused-metric variant: the Accuracy fold runs INSIDE the
         # compiled step (zero extra dispatches, zero per-batch host
@@ -622,12 +645,16 @@ class ShardedTrainer:
                 pred = head
                 if pred.ndim > 1:
                     pred = jnp.argmax(pred, axis=1)
+                # keep the carry a dtype fixed point: under x64 a bool-sum
+                # promotes to int64 and int32+int64 widens the output,
+                # which retraces the whole step program on the next batch
                 c = c + jnp.sum(pred.astype(jnp.int32).reshape(-1)
-                                == batch[ln].astype(jnp.int32).reshape(-1))
+                                == batch[ln].astype(jnp.int32).reshape(-1)
+                                ).astype(c.dtype)
             return new_p, new_a, new_o, heads, c
 
         self._train_step_acc = jax.jit(
-            train_step_acc,
+            _counted("train_acc", train_step_acc),
             out_shardings=(p_shard, a_shard, o_shard, None, None),
             donate_argnums=(0, 1, 2))
 
@@ -665,6 +692,37 @@ class ShardedTrainer:
                 out[n] = jax.device_put(v, sh)
         return _PlacedBatch(out)
 
+    def _guard_train_signature(self, placed: Dict[str, jax.Array]) -> None:
+        """Retrace guard: jax.jit caches executables keyed on input
+        shape/dtype/sharding, so a signature change silently recompiles
+        the whole step.  Record each distinct train-input signature; on a
+        change, name the offending inputs — warning by default, hard
+        MXNetError when ``strict_retrace`` is set."""
+        sig = tuple(sorted((n, tuple(v.shape), str(v.dtype))
+                           for n, v in placed.items()))
+        if sig in self._train_sigs:
+            return
+        if self._train_sigs:
+            prev = dict((n, (s, d)) for n, s, d in self._train_sigs[-1])
+            changed = [f"{n}: {prev.get(n)} -> {(s, d)}"
+                       for n, s, d in sig if prev.get(n) != (s, d)]
+            msg = ("train step input signature changed — this retraces and "
+                   "recompiles the step program (pad batches to a static "
+                   "shape instead): " + "; ".join(changed))
+            if self.strict_retrace:
+                raise MXNetError(msg)
+            self.logger.warning(msg)
+        self._train_sigs.append(sig)
+
+    def assert_steady_state(self) -> None:
+        """Raise unless every compiled step program traced exactly once —
+        the `dispatch_count == 1`-per-step contract pipeline_spmd asserts."""
+        bad = {k: v for k, v in self.trace_counts.items() if v > 1}
+        if bad:
+            raise MXNetError(
+                f"steady-state violated: programs retraced {bad}; distinct "
+                f"train signatures seen: {len(set(self._train_sigs))}")
+
     def step(self, batch) -> List[jax.Array]:
         """Run one training step; returns the head outputs (global arrays).
 
@@ -679,6 +737,11 @@ class ShardedTrainer:
         lr = (opt.lr_scheduler(self._num_update) if opt.lr_scheduler
               else opt.lr)
         placed = dict(self._place_batch(batch))
+        self._guard_train_signature(placed)
+        self.dispatch_count += 1
+        nd_mod.note_donation(
+            f"ShardedTrainer.step #{self._num_update} "
+            "(donate_argnums: params, aux, opt_state)")
         # scope the mesh so mesh-aware ops (RingAttention) pick up the seq
         # axis when this step traces
         with default_mesh(self.mesh), self._precision_scope():
@@ -699,6 +762,11 @@ class ShardedTrainer:
         lr = (opt.lr_scheduler(self._num_update) if opt.lr_scheduler
               else opt.lr)
         placed = dict(self._place_batch(batch))
+        self._guard_train_signature(placed)
+        self.dispatch_count += 1
+        nd_mod.note_donation(
+            f"ShardedTrainer.step #{self._num_update} "
+            "(donate_argnums: params, aux, opt_state)")
         with default_mesh(self.mesh), self._precision_scope():
             self._params, self._aux, self._opt_state, heads, carry = \
                 self._train_step_acc(self._params, self._aux,
@@ -709,6 +777,7 @@ class ShardedTrainer:
     def forward(self, batch) -> List[jax.Array]:
         """Inference forward (no aux update, no dropout)."""
         self._eval_count = getattr(self, "_eval_count", 0) + 1
+        self.dispatch_count += 1
         placed = dict(self._place_batch(batch))
         with default_mesh(self.mesh), self._precision_scope():
             return list(self._eval_step(self._params, self._aux, placed,
@@ -788,23 +857,28 @@ class ShardedTrainer:
         # get()/get_name_value() (e.g. from a Speedometer callback)
         # drain exactly then
         am = self._metric_proxy(eval_metric)
+        # async double-buffered input placement: a background thread pulls
+        # batch k+1 from the iterator and dispatches its sharded committed
+        # device_put while step k's compute runs — the host never sits
+        # between two device steps (the estimator-path analog of bench.py's
+        # place_batch prefetch, now fully off the dispatching thread)
+        from ..io import DevicePrefetchIter
+        prefetch = DevicePrefetchIter(train_data, place_fn=self.place_batch)
+        # the fused carry must start with the SAME aval+sharding the step
+        # program emits, or the second call retraces the whole program
+        # (caught by trace_counts: an uncommitted host int32(0) vs the
+        # mesh-replicated step output is a cache miss)
+        carry_sh = NamedSharding(self.mesh, P())
+        am.carry_init = lambda: jax.device_put(jnp.int32(0), carry_sh)
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()
             am.reset()
             nbatch = 0
-            train_data.reset()
-            # double-buffered input placement: batch i+1's host->device
-            # transfer is dispatched right after step i, so it overlaps
-            # step i's device compute (the estimator-path analog of
-            # bench.py's place_batch prefetch)
-            it = iter(train_data)
-            batch = next(it, None)
-            placed = self._place_batch(batch) if batch is not None else None
+            prefetch.reset()
             fused = am.supports_fused and bool(self._label_names)
             nheads = len(self.symbol.list_outputs())
             ninst_names = self._label_names[:nheads]
-            while batch is not None:
-                cur = placed
+            for cur in prefetch:
                 if fused:
                     # accuracy folds inside the step program: ONE dispatch
                     # per batch, no extra host<->device traffic at all
@@ -813,14 +887,11 @@ class ShardedTrainer:
                         int(np.prod(cur[n].shape)) for n in ninst_names))
                 else:
                     outs = self.step(cur)
-                nxt = next(it, None)
-                if nxt is not None:
-                    placed = self._place_batch(nxt)
-                if not fused:
                     # labels already live on device in the placed batch —
                     # no second host->device hop for the metric
                     lbls = ([cur[n] for n in self._label_names]
-                            if self._label_names else batch.label)
+                            if self._label_names
+                            else prefetch.current_source.label)
                     am.update_async(lbls, outs)
                 nbatch += 1
                 if batch_end_callback is not None:
@@ -828,7 +899,6 @@ class ShardedTrainer:
                     batch_end_callback(BatchEndParam(
                         epoch=epoch, nbatch=nbatch, eval_metric=am,
                         locals=locals()))
-                batch = nxt
             name, value = am.get()
             names = name if isinstance(name, list) else [name]
             values = value if isinstance(value, list) else [value]
@@ -886,6 +956,10 @@ class _AsyncMetric:
         self._dev_num = 0      # static instance count
         self._buf: List[Tuple[Any, Any]] = []
         self._period: Optional[int] = None
+        # optional factory for the epoch-initial carry; the trainer sets it
+        # to a mesh-replicated zero so the first fused step sees the same
+        # aval+sharding as every later one (no mid-epoch retrace)
+        self.carry_init = None
 
     # -- fused path (the correct-count fold runs inside the train step) --
 
@@ -894,7 +968,12 @@ class _AsyncMetric:
         return self._on_device
 
     def take_carry(self):
-        c = self._dev_sum if self._dev_sum is not None else jnp.int32(0)
+        if self._dev_sum is not None:
+            c = self._dev_sum
+        elif self.carry_init is not None:
+            c = self.carry_init()
+        else:
+            c = jnp.int32(0)
         self._dev_sum = None
         return c
 

@@ -116,6 +116,117 @@ def test_jpeg_feed_rate_and_thread_overhead(packed_224):
     assert CHIP_IMG_S / r1 <= 14.0  # cores per chip, JPEG worst case
 
 
+# ---------------------------------------------------------------------------
+# DevicePrefetchIter: the async device-placement stage (PR 2)
+# ---------------------------------------------------------------------------
+
+def _nd_iter(n=16, feat=4, batch=4):
+    from mxnet_tpu.io import NDArrayIter
+    data = np.arange(n * feat, dtype=np.float32).reshape(n, feat)
+    label = np.arange(n, dtype=np.float32)
+    return NDArrayIter(data, label, batch_size=batch)
+
+
+def test_device_prefetch_preserves_order_and_content():
+    """Prefetched batches are identical, in order, to direct iteration."""
+    from mxnet_tpu.io import DevicePrefetchIter
+    direct = [(b.data[0].asnumpy().copy(), b.label[0].asnumpy().copy())
+              for b in _nd_iter()]
+    pre = [(b.data[0].asnumpy().copy(), b.label[0].asnumpy().copy())
+           for b in DevicePrefetchIter(_nd_iter())]
+    assert len(direct) == len(pre) == 4
+    for (dd, dl), (pd, pl) in zip(direct, pre):
+        np.testing.assert_array_equal(dd, pd)
+        np.testing.assert_array_equal(dl, pl)
+
+
+def test_device_prefetch_exhaustion_and_reset():
+    from mxnet_tpu.io import DevicePrefetchIter
+    it = DevicePrefetchIter(_nd_iter())
+    assert sum(1 for _ in it) == 4
+    # exhausted: repeated next() keeps raising (sentinel is re-queued)
+    for _ in range(3):
+        with pytest.raises(StopIteration):
+            it.next()
+    it.reset()
+    assert sum(1 for _ in it) == 4
+
+
+def test_device_prefetch_propagates_worker_exception():
+    from mxnet_tpu.io import DataIter, DevicePrefetchIter
+
+    class Boom(RuntimeError):
+        pass
+
+    class FailingIter(DataIter):
+        def __init__(self, inner, fail_at):
+            super().__init__()
+            self.inner, self.fail_at, self.n = inner, fail_at, 0
+
+        @property
+        def provide_data(self):
+            return self.inner.provide_data
+
+        @property
+        def provide_label(self):
+            return self.inner.provide_label
+
+        def reset(self):
+            self.n = 0
+            self.inner.reset()
+
+        def next(self):
+            if self.n >= self.fail_at:
+                raise Boom("disk fell over")
+            self.n += 1
+            return self.inner.next()
+
+    it = DevicePrefetchIter(FailingIter(_nd_iter(), fail_at=2))
+    assert it.next() is not None
+    assert it.next() is not None
+    with pytest.raises(Boom, match="disk fell over"):
+        it.next()
+    # the error is sticky until reset, like the end sentinel
+    with pytest.raises(Boom):
+        it.next()
+
+
+def test_device_prefetch_place_fn_and_current_source():
+    """place_fn output is what next() returns; the raw inner batch stays
+    reachable via current_source (for pad/index bookkeeping)."""
+    from mxnet_tpu.io import DevicePrefetchIter
+    placed_ids = []
+
+    class Tagged:
+        def __init__(self, batch):
+            self.batch = batch
+            placed_ids.append(id(batch))
+
+    it = DevicePrefetchIter(_nd_iter(), place_fn=Tagged)
+    first = it.next()
+    assert isinstance(first, Tagged)
+    assert it.current_batch is first
+    assert id(it.current_source) in placed_ids
+    assert it.getpad() == it.current_source.pad
+    np.testing.assert_array_equal(it.getdata()[0].asnumpy(),
+                                  it.current_source.data[0].asnumpy())
+
+
+def test_device_prefetch_provide_shapes_delegate():
+    from mxnet_tpu.io import DevicePrefetchIter
+    inner = _nd_iter()
+    it = DevicePrefetchIter(inner)
+    assert it.provide_data == inner.provide_data
+    assert it.provide_label == inner.provide_label
+
+
+def test_device_prefetch_rejects_bad_depth():
+    from mxnet_tpu.base import MXNetError
+    from mxnet_tpu.io import DevicePrefetchIter
+    with pytest.raises(MXNetError):
+        DevicePrefetchIter(_nd_iter(), depth=0)
+
+
 def test_sharded_parts_cover_disjointly(packed_224):
     """num_parts=2 shards through the same consumer see disjoint rows
     whose union is the full record set."""

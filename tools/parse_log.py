@@ -4,8 +4,14 @@
 Parses the logging output of ``FeedForward.fit`` / ``Module.fit`` /
 ``ShardedTrainer.fit`` — epoch times, train/validation metrics,
 Speedometer throughput — and prints a per-epoch markdown table.
+
+``--diff-profile A B`` instead diffs two ``bench.py --profile-step``
+outputs: for every network present in both, a per-phase table of
+ms/step deltas (B - A) and percentages — the regression-triage view for
+step-overhead changes.
 """
 import argparse
+import json
 import re
 import sys
 from collections import defaultdict
@@ -41,10 +47,58 @@ def parse(lines):
     return rows
 
 
+def read_profiles(path):
+    """Collect {metric: {phase: ms}} from a bench.py --profile-step log
+    (one JSON object per line with a "step_profile" key; the last record
+    per metric wins)."""
+    profiles = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict) and "step_profile" in rec:
+                profiles[rec.get("metric", "?")] = rec["step_profile"]
+    return profiles
+
+
+def diff_profiles(path_a, path_b):
+    a, b = read_profiles(path_a), read_profiles(path_b)
+    common = [m for m in a if m in b]
+    if not common:
+        print("no common step_profile records between the two logs",
+              file=sys.stderr)
+        return 1
+    for metric in common:
+        pa, pb = a[metric], b[metric]
+        phases = [p for p in pa if p in pb]
+        print(f"\n{metric}")
+        print("| phase | A ms | B ms | delta ms | delta % |")
+        print("|---|---|---|---|---|")
+        for ph in phases:
+            va, vb = float(pa[ph]), float(pb[ph])
+            delta = vb - va
+            pct = f"{delta / va * 100:+.1f}%" if va else "n/a"
+            print(f"| {ph} | {va:.3f} | {vb:.3f} | {delta:+.3f} | {pct} |")
+    only = [m for m in (set(a) | set(b)) if m not in common]
+    if only:
+        print(f"\n(unmatched records: {sorted(only)})", file=sys.stderr)
+    return 0
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("logfile", nargs="?", help="default: stdin")
+    ap.add_argument("--diff-profile", nargs=2, metavar=("A", "B"),
+                    help="diff two bench.py --profile-step outputs "
+                    "(per-phase ms + %% deltas, B relative to A)")
     args = ap.parse_args()
+    if args.diff_profile:
+        return diff_profiles(*args.diff_profile)
     lines = (open(args.logfile).readlines() if args.logfile
              else sys.stdin.readlines())
     rows = parse(lines)
