@@ -48,6 +48,8 @@ from . import kvstore
 from . import executor_manager
 from . import model
 from .model import FeedForward, save_checkpoint, load_checkpoint
+from . import checkpoint
+from .checkpoint import CheckpointManager
 from . import module as mod
 from . import module
 from . import operator
@@ -70,5 +72,5 @@ __all__ = [
     "recordio", "image_io", "ImageRecordIter",
     "kvstore", "executor_manager", "model", "FeedForward", "lr_scheduler",
     "Initializer", "Uniform", "Normal", "Xavier", "Orthogonal", "Optimizer",
-    "save_checkpoint", "load_checkpoint",
+    "save_checkpoint", "load_checkpoint", "checkpoint", "CheckpointManager",
 ]

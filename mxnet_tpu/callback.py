@@ -19,11 +19,26 @@ import time
 __all__ = ["do_checkpoint", "log_train_metric", "Speedometer", "ProgressBar"]
 
 
-def do_checkpoint(prefix: str):
-    """Epoch-end callback saving ``prefix-%04d.params`` (reference
-    ``callback.py:11``)."""
+def do_checkpoint(prefix: str, manager=None):
+    """Epoch-end callback saving a checkpoint (reference ``callback.py:11``).
+
+    Default path: legacy ``prefix-symbol.json`` + ``prefix-%04d.params``
+    (now an atomic write — see ``nd.save``).  ``aux`` threads through
+    unchanged: a module without auxiliary states passes ``None`` and the
+    save writes no ``aux:`` entries instead of crashing.
+
+    With ``manager=`` (a :class:`mxnet_tpu.checkpoint.CheckpointManager`)
+    the save goes through the async sharded subsystem instead: the
+    device->host snapshot happens in the callback, the file writes
+    overlap the next epoch on the manager's writer thread, and retention
+    GC applies.  The ``(iter_no, sym, arg, aux)`` signature is unchanged
+    either way.
+    """
 
     def _callback(iter_no, sym, arg, aux):
+        if manager is not None:
+            manager.save_model(iter_no + 1, sym, arg, aux)
+            return
         from .model import save_checkpoint
         save_checkpoint(prefix, iter_no + 1, sym, arg, aux)
 

@@ -1,0 +1,33 @@
+"""Async sharded checkpointing: atomic snapshots, full trainer-state
+capture, resharding restore, preemption-safe auto-resume.
+
+Quick start (preemptible training script)::
+
+    import mxnet_tpu as mx
+
+    manager = mx.checkpoint.CheckpointManager(
+        "/ckpt/run1", save_interval_steps=500, keep_last=3)
+    trainer.bind(...)
+    trainer.restore_or_initialize(manager)       # no-op on first launch
+    manager.install_preemption_hook(
+        lambda: trainer.save_state(manager, blocking=True))
+    trainer.fit(train_iter, checkpoint_manager=manager, ...)
+
+See ``docs/checkpoint.md`` for the on-disk layout and manifest schema.
+"""
+from . import layout, reader, writer
+from .layout import (FORMAT_VERSION, MANIFEST_NAME, committed_steps,
+                     read_manifest)
+from .manager import CheckpointManager
+from .reader import (load_arrays, load_legacy_params, read_array,
+                     restore_array, verify_checkpoint)
+from .writer import (AsyncCheckpointWriter, gc_checkpoints, snapshot,
+                     sweep_staging, write_checkpoint)
+
+__all__ = [
+    "CheckpointManager", "FORMAT_VERSION", "MANIFEST_NAME",
+    "AsyncCheckpointWriter", "snapshot", "write_checkpoint",
+    "gc_checkpoints", "sweep_staging", "read_array", "restore_array",
+    "load_arrays", "verify_checkpoint", "load_legacy_params",
+    "committed_steps", "read_manifest", "layout", "reader", "writer",
+]

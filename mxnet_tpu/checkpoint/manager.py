@@ -1,0 +1,289 @@
+"""CheckpointManager: save policies, auto-resume, preemption safety.
+
+The user-facing object of the checkpoint subsystem.  One manager owns one
+checkpoint root directory and provides:
+
+* **save policies** — ``save_interval_steps`` / ``save_interval_seconds``
+  drive :meth:`should_save`; :meth:`save` snapshots synchronously (cheap,
+  per-shard D2H) and commits on the background writer thread, then runs
+  retention GC (``keep_last`` + ``keep_every`` milestones);
+* **discovery** — :meth:`latest_step` / :meth:`all_steps` see only
+  COMMITTED checkpoints (atomic-rename protocol, torn writes invisible);
+* **auto-resume** — :meth:`restore_or_initialize` restores the newest
+  checkpoint if one exists, else runs the initializer: the one call a
+  preemptible training script needs at startup;
+* **preemption** — :meth:`install_preemption_hook` registers a SIGTERM
+  handler that forces a final save and drains the writer before the
+  process dies, and sets :attr:`preempted` so training loops can exit
+  cleanly (TPU preemption sends SIGTERM with a grace window).
+
+Model-level helpers :meth:`save_model` / :meth:`load_model` store a
+Symbol + arg/aux params (the ``FeedForward``/``Module`` surface); trainer
+state (optimizer state, RNG, step counter) goes through
+``ShardedTrainer.save_state/restore_state`` which build on :meth:`save` /
+:meth:`restore`.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..base import MXNetError
+from . import layout, reader, writer
+
+__all__ = ["CheckpointManager"]
+
+
+class CheckpointManager:
+    def __init__(self, directory: str, keep_last: int = 3,
+                 keep_every: Optional[int] = None,
+                 save_interval_steps: Optional[int] = None,
+                 save_interval_seconds: Optional[float] = None,
+                 async_write: bool = True, verify_on_restore: bool = True,
+                 logger=None):
+        self.directory = str(directory)
+        self.keep_last = int(keep_last)
+        self.keep_every = int(keep_every) if keep_every else None
+        self.save_interval_steps = (int(save_interval_steps)
+                                    if save_interval_steps else None)
+        self.save_interval_seconds = (float(save_interval_seconds)
+                                      if save_interval_seconds else None)
+        self.async_write = async_write
+        self.verify_on_restore = verify_on_restore
+        self.logger = logger or logging.getLogger(__name__)
+        self.preempted = False
+        self._writer = writer.AsyncCheckpointWriter(logger=self.logger)
+        self._last_save_step: Optional[int] = None
+        self._last_save_time: Optional[float] = None
+        self._prev_handlers: Dict[int, Any] = {}
+        os.makedirs(self.directory, exist_ok=True)
+        swept = writer.sweep_staging(self.directory)
+        if swept:
+            self.logger.info("checkpoint: swept %d stale staging dir(s) "
+                             "from a previous crashed writer", len(swept))
+
+    # ------------------------------------------------------------------
+    # Discovery
+    # ------------------------------------------------------------------
+
+    def all_steps(self) -> List[int]:
+        return layout.committed_steps(self.directory)
+
+    def latest_step(self) -> Optional[int]:
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    def step_path(self, step: int) -> str:
+        return layout.step_path(self.directory, step)
+
+    # ------------------------------------------------------------------
+    # Save
+    # ------------------------------------------------------------------
+
+    def should_save(self, step: int) -> bool:
+        """Step/time policy gate; a preemption always says yes."""
+        if step == self._last_save_step:
+            return False  # already captured (e.g. by the preemption hook)
+        if self.preempted:
+            return True
+        now = time.monotonic()
+        if (self.save_interval_steps
+                and step % self.save_interval_steps == 0):
+            return True
+        if self.save_interval_seconds is not None:
+            if self._last_save_time is None:
+                self._last_save_time = now  # arm the clock on first ask
+                return False
+            return now - self._last_save_time >= self.save_interval_seconds
+        return False
+
+    def save(self, step: int, arrays: Dict[str, Any],
+             meta: Optional[Dict[str, Any]] = None,
+             blocking: Optional[bool] = None) -> str:
+        """Checkpoint ``{name: array}`` at ``step``.
+
+        The device->host snapshot happens NOW, on this thread (it must
+        precede the next donating train step); serialization, fsync,
+        atomic commit, and retention GC run on the writer thread unless
+        ``blocking`` (or the manager is configured sync).  Returns the
+        final checkpoint path (which exists only after the write lands —
+        ``wait_until_finished`` is the barrier).
+        """
+        step = int(step)
+        snap = writer.snapshot(arrays)
+        self._last_save_step = step
+        self._last_save_time = time.monotonic()
+
+        def commit():
+            writer.write_checkpoint(self.directory, step, snap, meta=meta)
+            writer.gc_checkpoints(self.directory, self.keep_last,
+                                  self.keep_every, logger=self.logger)
+            self.logger.info("checkpoint: committed step %d -> %s", step,
+                             layout.step_dir_name(step))
+
+        if blocking or (blocking is None and not self.async_write):
+            commit()
+        else:
+            self._writer.submit(commit)
+        return self.step_path(step)
+
+    def maybe_save(self, step: int, state_fn: Callable[[], Tuple],
+                   blocking: Optional[bool] = None) -> bool:
+        """Policy-gated save: when :meth:`should_save` fires, call
+        ``state_fn() -> (arrays, meta)`` and save.  The lazy callable
+        keeps state capture off the no-save fast path."""
+        if not self.should_save(step):
+            return False
+        arrays, meta = state_fn()
+        self.save(step, arrays, meta=meta,
+                  blocking=True if self.preempted else blocking)
+        return True
+
+    def wait_until_finished(self) -> None:
+        self._writer.wait_until_finished()
+
+    # ------------------------------------------------------------------
+    # Restore
+    # ------------------------------------------------------------------
+
+    def restore(self, step: Optional[int] = None,
+                shardings: Optional[Dict[str, Any]] = None,
+                target_shapes: Optional[Dict[str, Sequence[int]]] = None,
+                names: Optional[Sequence[str]] = None
+                ) -> Tuple[Dict[str, Any], Dict[str, Any], int]:
+        """Load checkpoint ``step`` (default: newest).
+
+        Returns ``(arrays, meta, step)``.  Arrays named in ``shardings``
+        come back as jax.Arrays resharded onto the given sharding (of the
+        CURRENT mesh — save-time layout does not matter); the rest are
+        host numpy.  ``target_shapes`` overrides per-name shapes for the
+        ZeRO flat-pad case.  Raises MXNetError if no committed checkpoint
+        exists or verification fails.
+        """
+        self.wait_until_finished()
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                raise MXNetError(
+                    f"no committed checkpoint under {self.directory!r}")
+        dirpath = self.step_path(step)
+        manifest = layout.read_manifest(dirpath)
+        entries = manifest["arrays"]
+        names = list(entries) if names is None else list(names)
+        shardings = shardings or {}
+        target_shapes = target_shapes or {}
+        out = {}
+        for name in names:
+            if name not in entries:
+                raise MXNetError(
+                    f"checkpoint step {step} has no array {name!r}")
+            out[name] = reader.restore_array(
+                dirpath, name, entries[name],
+                sharding=shardings.get(name),
+                target_shape=target_shapes.get(name),
+                verify=self.verify_on_restore)
+        return out, manifest.get("meta", {}), step
+
+    def restore_or_initialize(self, restore_fn: Callable[[int], Any],
+                              init_fn: Optional[Callable[[], Any]] = None):
+        """Auto-resume: newest committed checkpoint -> ``restore_fn(step)``;
+        none -> ``init_fn()`` (default no-op returning None).  This is the
+        idempotent startup call for preemptible jobs: the same script line
+        does the right thing on first launch and on every restart."""
+        step = self.latest_step()
+        if step is not None:
+            self.logger.info("checkpoint: resuming from step %d", step)
+            return restore_fn(step)
+        return init_fn() if init_fn is not None else None
+
+    # ------------------------------------------------------------------
+    # Preemption
+    # ------------------------------------------------------------------
+
+    def install_preemption_hook(self, save_fn: Callable[[], Any],
+                                signals: Sequence[int] = (signal.SIGTERM,),
+                                exit_after: bool = False) -> None:
+        """On SIGTERM (the TPU/cluster preemption notice): set
+        :attr:`preempted`, run ``save_fn`` (e.g. ``lambda:
+        trainer.save_state(manager, blocking=True)``), drain the writer,
+        then chain to the previous handler (and exit 143 if
+        ``exit_after``).  Training loops that poll :attr:`preempted`
+        (``ShardedTrainer.fit(checkpoint_manager=...)`` does) stop at the
+        next batch boundary instead."""
+
+        def handler(signum, frame):
+            already = self.preempted
+            self.preempted = True
+            if not already:
+                self.logger.warning(
+                    "checkpoint: signal %d received — forcing a final "
+                    "save before shutdown", signum)
+                try:
+                    save_fn()
+                finally:
+                    self.wait_until_finished()
+            prev = self._prev_handlers.get(signum)
+            if callable(prev) and prev not in (signal.SIG_IGN,
+                                               signal.SIG_DFL):
+                prev(signum, frame)
+            if exit_after:
+                raise SystemExit(128 + signum)
+
+        if threading.current_thread() is not threading.main_thread():
+            raise MXNetError("install_preemption_hook must run on the "
+                             "main thread (signal module restriction)")
+        for sig in signals:
+            self._prev_handlers[sig] = signal.getsignal(sig)
+            signal.signal(sig, handler)
+
+    def uninstall_preemption_hook(self) -> None:
+        for sig, prev in self._prev_handlers.items():
+            signal.signal(sig, prev)
+        self._prev_handlers.clear()
+
+    # ------------------------------------------------------------------
+    # Model-level convenience (FeedForward / Module surface)
+    # ------------------------------------------------------------------
+
+    def save_model(self, step: int, symbol, arg_params: Dict[str, Any],
+                   aux_params: Optional[Dict[str, Any]] = None,
+                   meta: Optional[Dict[str, Any]] = None,
+                   extra_arrays: Optional[Dict[str, Any]] = None,
+                   blocking: Optional[bool] = None) -> str:
+        """Save a Symbol + params the way ``model.save_checkpoint`` does,
+        but sharded/atomic/async.  The symbol JSON rides in the manifest
+        meta, so one checkpoint dir is self-contained.  ``extra_arrays``
+        (unprefixed names) carries side state like Module optimizer
+        blobs."""
+        arrays = {f"arg:{k}": v for k, v in (arg_params or {}).items()}
+        arrays.update({f"aux:{k}": v for k, v in (aux_params or {}).items()})
+        arrays.update(extra_arrays or {})
+        meta = dict(meta or {})
+        if symbol is not None:
+            meta["symbol_json"] = symbol.tojson()
+        return self.save(step, arrays, meta=meta, blocking=blocking)
+
+    def load_model(self, step: Optional[int] = None):
+        """Inverse of :meth:`save_model`: returns ``(symbol, arg_params,
+        aux_params, step)`` with NDArray params (the load_checkpoint
+        contract)."""
+        from .. import symbol as sym_mod
+        from ..model import split_param_dict
+        from ..ndarray import array as nd_array
+        arrays, meta, step = self.restore(step)
+        symbol = (sym_mod.load_json(meta["symbol_json"])
+                  if "symbol_json" in meta else None)
+        # unprefixed names are side state (e.g. Module optimizer blobs),
+        # not parameters — load those explicitly via restore()/load_arrays
+        nds = {k: nd_array(v) for k, v in arrays.items()
+               if k.startswith(("arg:", "aux:"))}
+        arg_params, aux_params = split_param_dict(nds)
+        return symbol, arg_params, aux_params, step
+
+    def close(self) -> None:
+        self._writer.close()
+        self.uninstall_preemption_hook()

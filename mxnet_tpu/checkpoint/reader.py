@@ -1,0 +1,211 @@
+"""Checkpoint restore: verify, assemble, reshard onto the CURRENT mesh.
+
+The saved shard layout and the restoring job's layout are independent: a
+checkpoint written on an 8-chip data mesh restores onto 4 chips (or a
+different ShardingRules placement) because restore goes through
+``jax.make_array_from_callback`` — JAX asks for exactly the regions the
+current sharding needs on this host, and :func:`_assemble_region` serves
+each from whichever SAVED shards overlap it.  Only the overlapping shard
+files are read and checksum-verified; a fully-resharded restore never
+materializes more than one addressable region at a time beyond the shard
+files it touches.
+
+ZeRO flatten-and-pad states get one extra freedom: their padded length
+depends on the data-axis size (``ceil(numel/N)*N``), so a mesh-size
+change legitimately changes the 1-D shape.  Because the pad tail is
+zeros by construction in BOTH layouts, :func:`_adapt_shape`
+truncates/zero-extends 1-D leaves to the target length — exact, not
+approximate.
+
+Legacy fallback: :func:`load_legacy_params` reads the reference-format
+``prefix-%04d.params`` files (``nd.load``) so pre-subsystem checkpoints
+keep restoring.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..base import MXNetError
+from . import layout
+
+__all__ = ["read_array", "restore_array", "load_arrays", "verify_checkpoint",
+           "load_legacy_params"]
+
+
+class _ShardFileCache:
+    """Read + verify each shard file at most once per restore call."""
+
+    def __init__(self, dirpath: str, verify: bool = True):
+        self.dirpath = dirpath
+        self.verify = verify
+        self._cache: Dict[str, np.ndarray] = {}
+
+    def shard_data(self, name: str, entry: Dict[str, Any],
+                   shard: Dict[str, Any]) -> np.ndarray:
+        fname = shard["file"]
+        if fname in self._cache:
+            return self._cache[fname]
+        path = os.path.join(self.dirpath, fname)
+        if not os.path.isfile(path):
+            raise MXNetError(
+                f"checkpoint {self.dirpath}: array {name!r} shard file "
+                f"{fname} is missing")
+        with open(path, "rb") as f:
+            payload = f.read()
+        if len(payload) != int(shard["nbytes"]):
+            raise MXNetError(
+                f"checkpoint {self.dirpath}: array {name!r} shard {fname} "
+                f"truncated ({len(payload)} bytes, manifest says "
+                f"{shard['nbytes']})")
+        if self.verify:
+            layout.verify_checksum(payload, shard["checksum"],
+                                   f"array {name!r} shard {fname}")
+        dtype = np.dtype(entry["dtype"])
+        shape = tuple(stop - start for start, stop in shard["index"])
+        arr = np.frombuffer(payload, dtype=dtype).reshape(shape)
+        self._cache[fname] = arr
+        return arr
+
+
+def _assemble_region(name: str, entry: Dict[str, Any],
+                     region: Sequence[Tuple[int, int]],
+                     cache: _ShardFileCache) -> np.ndarray:
+    """Assemble the half-open ``region`` of an array from the saved
+    shards that overlap it."""
+    dtype = np.dtype(entry["dtype"])
+    out_shape = tuple(stop - start for start, stop in region)
+    out = np.empty(out_shape, dtype=dtype)
+    filled = 0
+    for shard in entry["shards"]:
+        index = shard["index"]
+        # overlap of this shard with the requested region, in global coords
+        overlap = [(max(r0, s0), min(r1, s1))
+                   for (r0, r1), (s0, s1) in zip(region, index)]
+        if any(a >= b for a, b in overlap) and out_shape != ():
+            continue
+        data = cache.shard_data(name, entry, shard)
+        src = tuple(slice(a - s0, b - s0)
+                    for (a, b), (s0, _) in zip(overlap, index))
+        dst = tuple(slice(a - r0, b - r0)
+                    for (a, b), (r0, _) in zip(overlap, region))
+        out[dst] = data[src]
+        filled += int(np.prod([b - a for a, b in overlap])) if overlap else 1
+    size = int(np.prod(out_shape)) if out_shape else 1
+    if filled < size:
+        raise MXNetError(
+            f"checkpoint {cache.dirpath}: array {name!r} region {region} "
+            f"not fully covered by saved shards ({filled}/{size} elements) "
+            "— incomplete multi-host checkpoint?")
+    return out
+
+
+def read_array(dirpath: str, name: str, entry: Dict[str, Any],
+               verify: bool = True) -> np.ndarray:
+    """Assemble one array fully on host (tools / tests / host restores)."""
+    region = [(0, int(d)) for d in entry["shape"]]
+    return _assemble_region(name, entry, region,
+                            _ShardFileCache(dirpath, verify))
+
+
+def _adapt_shape(name: str, full: np.ndarray,
+                 target_shape: Sequence[int]) -> np.ndarray:
+    """Reconcile a saved shape with the restoring job's shape.  Only the
+    ZeRO flatten-and-pad case (1-D, zero tail, length = f(mesh size)) is
+    legal; anything else is a real mismatch and raises."""
+    target_shape = tuple(int(s) for s in target_shape)
+    if full.shape == target_shape:
+        return full
+    if full.ndim == 1 and len(target_shape) == 1:
+        n = target_shape[0]
+        if full.shape[0] > n:
+            if np.any(full[n:] != 0):
+                raise MXNetError(
+                    f"restore: 1-D state {name!r} shrinks {full.shape[0]} "
+                    f"-> {n} but the tail is non-zero — not a "
+                    "flatten-and-pad layout, refusing to truncate")
+            return np.ascontiguousarray(full[:n])
+        out = np.zeros(target_shape, dtype=full.dtype)
+        out[:full.shape[0]] = full
+        return out
+    raise MXNetError(
+        f"restore: array {name!r} has shape {tuple(full.shape)} in the "
+        f"checkpoint but {target_shape} in this job — the model changed "
+        "(only ZeRO flat-pad 1-D length changes reshard automatically)")
+
+
+def restore_array(dirpath: str, name: str, entry: Dict[str, Any],
+                  sharding=None, target_shape=None, verify: bool = True):
+    """Restore one array, resharded onto ``sharding`` (a NamedSharding of
+    the CURRENT mesh) when given, else as host numpy.
+
+    ``target_shape`` (default: the saved shape) lets ZeRO flat-pad states
+    change padded length with the mesh; other shape changes raise.
+    """
+    import jax
+
+    saved_shape = tuple(int(d) for d in entry["shape"])
+    cache = _ShardFileCache(dirpath, verify)
+    if sharding is None:
+        full = _assemble_region(name, entry,
+                                [(0, d) for d in saved_shape], cache)
+        if target_shape is not None:
+            full = _adapt_shape(name, full, target_shape)
+        return full
+    target_shape = tuple(int(s) for s in (target_shape or saved_shape))
+    if target_shape != saved_shape:
+        full = _assemble_region(name, entry,
+                                [(0, d) for d in saved_shape], cache)
+        full = _adapt_shape(name, full, target_shape)
+        return jax.device_put(full, sharding)
+
+    def fetch(index):
+        region = layout.normalize_index(index, saved_shape)
+        return _assemble_region(name, entry, region, cache)
+
+    return jax.make_array_from_callback(saved_shape, sharding, fetch)
+
+
+def load_arrays(dirpath: str, names: Optional[Sequence[str]] = None,
+                verify: bool = True) -> Dict[str, np.ndarray]:
+    """Host-side bulk load (ckpt_inspect, FeedForward/Module restores)."""
+    manifest = layout.read_manifest(dirpath)
+    arrays = manifest["arrays"]
+    names = list(arrays) if names is None else list(names)
+    out = {}
+    for name in names:
+        if name not in arrays:
+            raise MXNetError(f"checkpoint {dirpath} has no array {name!r} "
+                             f"(has: {sorted(arrays)[:8]}...)")
+        out[name] = read_array(dirpath, name, arrays[name], verify=verify)
+    return out
+
+
+def verify_checkpoint(dirpath: str) -> Dict[str, Any]:
+    """Full integrity pass: every shard of every array read + checksummed.
+    Returns ``{"arrays": n, "shards": n, "bytes": n}``; raises MXNetError
+    naming the first bad shard."""
+    manifest = layout.read_manifest(dirpath)
+    cache = _ShardFileCache(dirpath, verify=True)
+    shards = nbytes = 0
+    for name, entry in manifest["arrays"].items():
+        for shard in entry["shards"]:
+            cache.shard_data(name, entry, shard)
+            shards += 1
+            nbytes += int(shard["nbytes"])
+    return {"arrays": len(manifest["arrays"]), "shards": shards,
+            "bytes": nbytes}
+
+
+def load_legacy_params(path: str) -> Dict[str, np.ndarray]:
+    """Read a reference-format ``.params`` file into host arrays keyed by
+    the raw ``arg:``/``aux:``-prefixed names (the pre-subsystem layout
+    ``model.save_checkpoint`` writes)."""
+    from .. import ndarray as nd
+    loaded = nd.load(path)
+    if not isinstance(loaded, dict):
+        raise MXNetError(f"{path}: legacy .params file holds an unnamed "
+                         "list, not a param dict")
+    return {k: v.asnumpy() for k, v in loaded.items()}

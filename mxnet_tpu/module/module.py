@@ -61,6 +61,7 @@ class Module(BaseModule):
         self._updater = None
         self._exec_group: Optional[DataParallelExecutorGroup] = None
         self._preload_opt_states = None
+        self._preload_opt_blob = None
 
     @staticmethod
     def load(prefix: str, epoch: int, load_optimizer_states: bool = False,
@@ -84,6 +85,44 @@ class Module(BaseModule):
         save_checkpoint(prefix, epoch, self.symbol, arg_params, aux_params)
         if save_optimizer_states:
             self.save_optimizer_states("%s-%04d.states" % (prefix, epoch))
+
+    def save_to_manager(self, manager, epoch: int,
+                        save_optimizer_states: bool = False,
+                        blocking: Optional[bool] = None) -> str:
+        """CheckpointManager-backed :meth:`save_checkpoint`: symbol +
+        params (+ optionally the updater's optimizer states) land in one
+        atomic, async, GC'd checkpoint dir instead of three loose files."""
+        arrays = None
+        if save_optimizer_states:
+            assert self.optimizer_initialized
+            import pickle
+            import numpy as np
+            from ..optimizer import states_to_host
+            blob = pickle.dumps(states_to_host(self._updater.states))
+            arrays = {"opt_states": np.frombuffer(blob, np.uint8)}
+        arg_params, aux_params = self.get_params()
+        return manager.save_model(epoch, self.symbol, arg_params,
+                                  aux_params, extra_arrays=arrays,
+                                  blocking=blocking)
+
+    @staticmethod
+    def load_from_manager(manager, step: Optional[int] = None,
+                          load_optimizer_states: bool = False,
+                          **kwargs) -> "Module":
+        """CheckpointManager-backed :meth:`load` (default: newest
+        committed step).  Optimizer states, when saved, re-apply at
+        ``init_optimizer`` time exactly like the ``.states`` preload."""
+        sym, args, auxs, step = manager.load_model(step)
+        mod = Module(symbol=sym, **kwargs)
+        mod._arg_params = args
+        mod._aux_params = auxs
+        mod.params_initialized = True
+        if load_optimizer_states:
+            from ..checkpoint import load_arrays
+            loaded = load_arrays(manager.step_path(step),
+                                 names=["opt_states"])
+            mod._preload_opt_blob = loaded["opt_states"].tobytes()
+        return mod
 
     # ------------------------------------------------------------------
 
@@ -252,6 +291,10 @@ class Module(BaseModule):
         if self._preload_opt_states is not None:
             self.load_optimizer_states(self._preload_opt_states)
             self._preload_opt_states = None
+        if self._preload_opt_blob is not None:
+            import pickle
+            self._apply_host_states(pickle.loads(self._preload_opt_blob))
+            self._preload_opt_blob = None
 
     def borrow_optimizer(self, shared_module: "Module") -> None:
         assert shared_module.optimizer_initialized
@@ -325,23 +368,28 @@ class Module(BaseModule):
             self._kvstore.load_optimizer_states(fname)
         else:
             import pickle
-            from ..optimizer import states_from_host
-            num_device = len(self._context)
-            param_arrays = self._exec_group.param_arrays
-
-            def ctx_for_key(key):
-                # updater keys are param_index * num_device + device_k
-                # (model._update_params) — states live with their weights
-                i, k = divmod(key, num_device) if isinstance(key, int) \
-                    else (None, None)
-                if i is not None and i < len(param_arrays):
-                    return param_arrays[i][k].context
-                return None
-
             with open(fname, "rb") as f:
                 blob = pickle.loads(f.read())
-            self._updater.states.clear()
-            self._updater.states.update(states_from_host(blob, ctx_for_key))
+            self._apply_host_states(blob)
+
+    def _apply_host_states(self, blob) -> None:
+        """Install ``states_to_host``-form optimizer states into the local
+        updater, placing each state with its weight's context."""
+        from ..optimizer import states_from_host
+        num_device = len(self._context)
+        param_arrays = self._exec_group.param_arrays
+
+        def ctx_for_key(key):
+            # updater keys are param_index * num_device + device_k
+            # (model._update_params) — states live with their weights
+            i, k = divmod(key, num_device) if isinstance(key, int) \
+                else (None, None)
+            if i is not None and i < len(param_arrays):
+                return param_arrays[i][k].context
+            return None
+
+        self._updater.states.clear()
+        self._updater.states.update(states_from_host(blob, ctx_for_key))
 
     def install_monitor(self, mon):
         assert self.binded

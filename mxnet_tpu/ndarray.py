@@ -20,6 +20,7 @@ TPU-native rebuild of the reference NDArray (``include/mxnet/ndarray.h:31-355``,
 """
 from __future__ import annotations
 
+import os
 import struct
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
@@ -460,7 +461,15 @@ _SAVE_MAGIC = b"MXTPUND1"
 
 
 def save(fname: str, data: Union[List[NDArray], Dict[str, NDArray]]) -> None:
-    """Save a list or dict of NDArrays (reference ``ndarray.py:save``)."""
+    """Save a list or dict of NDArrays (reference ``ndarray.py:save``).
+
+    Local-file writes are atomic: the payload lands in a same-directory
+    temp file that is ``os.replace``d into place, so a process killed
+    mid-save leaves the previous file intact instead of a torn one
+    (the legacy-path sibling of the checkpoint subsystem's staging-dir
+    commit).  Non-file schemes (memory://, s3://...) write directly —
+    their stores have their own commit semantics.
+    """
     if isinstance(data, dict):
         names = list(data.keys())
         arrays = [data[k] for k in names]
@@ -469,8 +478,8 @@ def save(fname: str, data: Union[List[NDArray], Dict[str, NDArray]]) -> None:
         arrays = list(data)
     else:
         raise MXNetError("save expects list or dict of NDArrays")
-    from .stream import open_uri
-    with open_uri(fname, "wb") as f:
+
+    def _write(f):
         f.write(_SAVE_MAGIC)
         f.write(struct.pack("<qq", len(arrays), len(names)))
         for i, arr in enumerate(arrays):
@@ -486,6 +495,42 @@ def save(fname: str, data: Union[List[NDArray], Dict[str, NDArray]]) -> None:
             f.write(struct.pack("<i", len(nb)))
             f.write(nb)
 
+    from .stream import open_uri, split_scheme
+    scheme, path = split_scheme(fname)
+    if scheme != "file":
+        with open_uri(fname, "wb") as f:
+            _write(f)
+        return
+    parent = os.path.dirname(path)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+    tmp = f"{path}.tmp-{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            _write(f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def _read_exact(f, nbytes: int, fname: str, what: str) -> bytes:
+    """Read exactly ``nbytes`` or raise an MXNetError naming the file and
+    the structure being read — a truncated file fails loudly here instead
+    of as an opaque struct/frombuffer ValueError (or, worse, silently
+    misparsed names)."""
+    buf = f.read(nbytes)
+    if len(buf) != nbytes:
+        raise MXNetError(
+            f"{fname}: truncated NDArray file — expected {nbytes} bytes "
+            f"for {what}, got {len(buf)}")
+    return buf
+
 
 def load(fname: str) -> Union[List[NDArray], Dict[str, NDArray]]:
     from .stream import open_uri
@@ -493,20 +538,44 @@ def load(fname: str) -> Union[List[NDArray], Dict[str, NDArray]]:
         magic = f.read(8)
         if magic != _SAVE_MAGIC:
             raise MXNetError(f"{fname}: bad magic, not an NDArray file")
-        n_arr, n_names = struct.unpack("<qq", f.read(16))
+        n_arr, n_names = struct.unpack(
+            "<qq", _read_exact(f, 16, fname, "the array/name counts"))
+        if n_arr < 0 or n_names < 0 or (n_names and n_names != n_arr):
+            raise MXNetError(
+                f"{fname}: corrupt header — {n_arr} arrays, {n_names} names")
         arrays = []
-        for _ in range(n_arr):
-            (dt_len,) = struct.unpack("<i", f.read(4))
-            dt = np.dtype(f.read(dt_len).decode())
-            (ndim,) = struct.unpack("<i", f.read(4))
-            shape = struct.unpack(f"<{ndim}q", f.read(8 * ndim)) if ndim else ()
+        for i in range(n_arr):
+            what = f"array {i}"
+            (dt_len,) = struct.unpack(
+                "<i", _read_exact(f, 4, fname, f"{what} dtype length"))
+            try:
+                dt = np.dtype(
+                    _read_exact(f, dt_len, fname, f"{what} dtype").decode())
+            except (TypeError, ValueError, UnicodeDecodeError) as e:
+                raise MXNetError(f"{fname}: {what} has an invalid dtype "
+                                 f"descriptor: {e}") from e
+            (ndim,) = struct.unpack(
+                "<i", _read_exact(f, 4, fname, f"{what} ndim"))
+            if not 0 <= ndim <= 32:
+                raise MXNetError(f"{fname}: {what} has corrupt ndim {ndim}")
+            shape = struct.unpack(
+                f"<{ndim}q",
+                _read_exact(f, 8 * ndim, fname, f"{what} shape")) \
+                if ndim else ()
+            if any(d < 0 for d in shape):
+                raise MXNetError(
+                    f"{fname}: {what} has corrupt shape {shape}")
             count = int(np.prod(shape)) if shape else 1
-            buf = f.read(count * dt.itemsize)
+            buf = _read_exact(f, count * dt.itemsize, fname,
+                              f"{what} payload (shape {tuple(shape)})")
             arrays.append(NDArray(np.frombuffer(buf, dtype=dt).reshape(shape).copy()))
         names = []
-        for _ in range(n_names):
-            (ln,) = struct.unpack("<i", f.read(4))
-            names.append(f.read(ln).decode())
+        for i in range(n_names):
+            (ln,) = struct.unpack(
+                "<i", _read_exact(f, 4, fname, f"name {i} length"))
+            if ln < 0:
+                raise MXNetError(f"{fname}: name {i} has corrupt length {ln}")
+            names.append(_read_exact(f, ln, fname, f"name {i}").decode())
     if names:
         return dict(zip(names, arrays))
     return arrays

@@ -96,6 +96,25 @@ class _PlacedBatch(dict):
     ``_place_batch`` passes them through without re-dispatching puts."""
 
 
+def _key_to_meta(key) -> Dict[str, Any]:
+    """PRNG key -> JSON-safe manifest meta (handles both raw uint32
+    keys and typed key arrays)."""
+    try:
+        data = np.asarray(jax.random.key_data(key))
+        typed = bool(jnp.issubdtype(key.dtype, jax.dtypes.prng_key))
+    except Exception:
+        data, typed = np.asarray(key), False
+    return {"data": [int(x) for x in data.ravel().tolist()],
+            "shape": list(data.shape), "typed": typed}
+
+
+def _key_from_meta(meta: Dict[str, Any]):
+    data = np.asarray(meta["data"], np.uint32).reshape(meta["shape"])
+    if meta.get("typed"):
+        return jax.random.wrap_key_data(jnp.asarray(data))
+    return jnp.asarray(data)
+
+
 class ShardedTrainer:
     """Compiled data/tensor-parallel trainer for a Symbol.
 
@@ -474,9 +493,14 @@ class ShardedTrainer:
 
         # one base key captured at compile; per-step keys fold from the
         # update counter INSIDE the program (no per-step host->device key
-        # transfer — each one is a round-trip on tunneled backends)
+        # transfer — each one is a round-trip on tunneled backends).  The
+        # key persists on the trainer so checkpoints can capture it:
+        # restore_state sets _base_key and recompiles, and every post-
+        # resume step folds the SAME stream it would have uninterrupted.
         from .. import random as _random
-        base_key = _random._next_key()
+        if getattr(self, "_base_key", None) is None:
+            self._base_key = _random._next_key()
+        base_key = self._base_key
         # distinct stream for eval so eval-mode rng never correlates with
         # the train step that shares a counter value
         eval_key = jax.random.fold_in(base_key, 0x5EED)
@@ -803,6 +827,102 @@ class ShardedTrainer:
                 val = v.data if isinstance(v, NDArray) else jnp.asarray(v)
                 self._aux[n] = self._global_put(val, replicated(self.mesh))
 
+    # ------------------------------------------------------------------
+    # Checkpointing (full trainer state: params, aux, opt_state, step, RNG)
+    # ------------------------------------------------------------------
+
+    def _state_arrays(self) -> Dict[str, jax.Array]:
+        """Flat ``{name: array}`` view of the full trainer state.  Names
+        are namespaced (``param:``/``aux:``/``opt:<param>:<leaf>``) so one
+        checkpoint dict round-trips through CheckpointManager and the
+        optimizer pytree re-assembles leaf-by-leaf on restore."""
+        if not self._bound:
+            raise MXNetError("call bind() before save_state/restore_state")
+        arrays = {f"param:{n}": self._params[n] for n in self._param_names}
+        arrays.update({f"aux:{n}": self._aux[n] for n in self._aux_names})
+        for n in self._param_names:
+            for i, leaf in enumerate(
+                    jax.tree_util.tree_leaves(self._opt_state[n])):
+                arrays[f"opt:{n}:{i}"] = leaf
+        return arrays
+
+    def _state_meta(self, extra_meta=None) -> Dict[str, Any]:
+        meta = {"state": "sharded_trainer",
+                "num_update": int(self._num_update),
+                "optimizer": type(self.optimizer).__name__,
+                "rng_key": _key_to_meta(self._base_key),
+                "data_axis_size": (self.mesh.shape[self.data_axis]
+                                   if self.data_axis is not None else 1)}
+        if extra_meta:
+            meta.update(extra_meta)
+        return meta
+
+    def save_state(self, manager, step: Optional[int] = None,
+                   blocking: Optional[bool] = None,
+                   extra_meta: Optional[Dict[str, Any]] = None) -> str:
+        """Checkpoint the FULL trainer state (params, aux, optimizer
+        state, update counter, RNG base key) through a
+        :class:`~mxnet_tpu.checkpoint.CheckpointManager`.
+
+        The device->host snapshot completes before this returns, so the
+        next (donating) :meth:`step` is safe immediately; file writes
+        overlap it on the manager's writer thread unless ``blocking``.
+        """
+        step = self._num_update if step is None else int(step)
+        return manager.save(step, self._state_arrays(),
+                            meta=self._state_meta(extra_meta),
+                            blocking=blocking)
+
+    def restore_state(self, manager, step: Optional[int] = None
+                      ) -> Tuple[Dict[str, Any], int]:
+        """Restore trainer state from ``manager`` (default: newest step),
+        resharding every array onto THIS trainer's mesh — the saving
+        run's device count/layout does not have to match.  Returns
+        ``(meta, step)``; after it, the next :meth:`step` continues the
+        interrupted run bitwise (same params, opt state, lr clock, and
+        RNG stream)."""
+        if not self._bound:
+            raise MXNetError("call bind() before restore_state")
+        shardings: Dict[str, Any] = {}
+        target_shapes: Dict[str, Tuple[int, ...]] = {}
+        names: List[str] = []
+        for name, arr in self._state_arrays().items():
+            names.append(name)
+            shardings[name] = arr.sharding
+            if name.startswith("opt:"):
+                # ZeRO flat-pad lengths are f(data-axis size): restore to
+                # THIS mesh's padded length, not the saved one
+                target_shapes[name] = tuple(arr.shape)
+        arrays, meta, step = manager.restore(
+            step=step, shardings=shardings, target_shapes=target_shapes,
+            names=names)
+        for n in self._param_names:
+            self._params[n] = arrays[f"param:{n}"]
+        for n in self._aux_names:
+            self._aux[n] = arrays[f"aux:{n}"]
+        for n in self._param_names:
+            treedef = jax.tree_util.tree_structure(self._opt_state[n])
+            leaves = [arrays[f"opt:{n}:{i}"]
+                      for i in range(treedef.num_leaves)]
+            self._opt_state[n] = jax.tree_util.tree_unflatten(treedef,
+                                                              leaves)
+        self._num_update = int(meta.get("num_update", step))
+        if "rng_key" in meta:
+            self._base_key = _key_from_meta(meta["rng_key"])
+            # recompile: the step programs close over the base key
+            self._compile()
+        self.logger.info("restore_state: resumed at update %d from %s",
+                         self._num_update, manager.step_path(step))
+        return meta, step
+
+    def restore_or_initialize(self, manager) -> Optional[int]:
+        """Auto-resume glue: restore the newest checkpoint if the manager
+        has one (returning its step), else leave the freshly-bound state
+        untouched and return None.  Idempotent across preemption
+        restarts."""
+        return manager.restore_or_initialize(
+            lambda step: self.restore_state(manager, step=step)[1])
+
     def _metric_proxy(self, eval_metric):
         return _AsyncMetric(eval_metric)
 
@@ -818,14 +938,39 @@ class ShardedTrainer:
                                              for o in outs])
         return eval_metric
 
+    def _fit_checkpoint(self, manager, am, epoch: int, nbatch: int) -> None:
+        """Per-batch checkpoint hook for :meth:`fit`: policy-gated (or
+        preemption-forced) full-state save.  The fused-metric carry is
+        drained into the meta so a resumed epoch's running metric is not
+        silently zero.  The snapshot runs here, on the dispatching thread,
+        BEFORE the next step donates the buffers being saved."""
+
+        def state_fn():
+            extra = {"epoch": epoch, "nbatch": nbatch}
+            if am._dev_sum is not None:
+                # scalar sync — only paid on the (rare) batches that save
+                extra["metric_sum"] = int(np.asarray(am._dev_sum))
+                extra["metric_num"] = int(am._dev_num)
+            return self._state_arrays(), self._state_meta(extra)
+
+        manager.maybe_save(self._num_update, state_fn)
+
     def fit(self, train_data, eval_data=None, eval_metric="acc",
             num_epoch: int = 1, begin_epoch: int = 0,
-            batch_end_callback=None, epoch_end_callback=None) -> None:
+            batch_end_callback=None, epoch_end_callback=None,
+            checkpoint_manager=None) -> None:
         """Mesh-native training loop: per batch, one compiled device step.
 
         Unlike the reference loop (``model.py:119``) there is no push/pull
         phase — gradient reduction is inside :meth:`step`.  ``begin_epoch``
         resumes checkpoint numbering and the optimizer's update count.
+
+        ``checkpoint_manager`` enables in-loop checkpointing: after each
+        step the manager's save policy may trigger a full
+        :meth:`save_state` (snapshot on this thread, writes overlapped on
+        the manager's background writer), and a SIGTERM preemption
+        (``manager.preempted``) forces a final blocking save and stops the
+        loop at the batch boundary.
         """
         from ..metric import create as metric_create
         if isinstance(eval_metric, str):
@@ -899,6 +1044,16 @@ class ShardedTrainer:
                     batch_end_callback(BatchEndParam(
                         epoch=epoch, nbatch=nbatch, eval_metric=am,
                         locals=locals()))
+                if checkpoint_manager is not None:
+                    self._fit_checkpoint(checkpoint_manager, am, epoch,
+                                         nbatch)
+                    if checkpoint_manager.preempted:
+                        self.logger.warning(
+                            "fit: preemption signal received — state saved "
+                            "at update %d, stopping (restore_or_initialize "
+                            "resumes on restart)", self._num_update)
+                        checkpoint_manager.wait_until_finished()
+                        return
             name, value = am.get()
             names = name if isinstance(name, list) else [name]
             values = value if isinstance(value, list) else [value]
