@@ -1,0 +1,587 @@
+"""Compile-management: persistent program cache + bucket canonicalization.
+
+Kill the cold start.  BENCH_r05 put XLA compile time at 41-61 s per train
+program against a ~24-110 ms steady-state step; on a preemptible fleet
+(PR 3's auto-resume restarts often) compilation is the dominant
+wall-clock tax, and ``BucketingModule`` multiplies it by one
+shape-specialized program per bucket.  Three levers live here:
+
+* :class:`ProgramCache` — an in-process LRU over compiled XLA
+  executables with an opt-in on-disk layer
+  (``jax.experimental.serialize_executable``), keyed by
+  :func:`program_key` (graph fingerprint, avals, shardings, donation
+  set, mesh, backend, jax/jaxlib version).  A restarted trainer
+  re-attaches to yesterday's programs in milliseconds.
+* :func:`enable_persistent_cache` — wires jax's own
+  ``jax_compilation_cache_dir`` (the HLO-keyed XLA cache) under the
+  same root, so even programs that bypass our keyed store (tracing
+  through plain ``jax.jit``) skip the XLA backend compile on re-run.
+* :class:`BucketPolicy` / :func:`plan_shape_buckets` — geometric
+  shape-bucket canonicalization: dozens of dynamic sequence lengths
+  round up into ~4-8 padded buckets, collapsing per-length programs.
+  ``BucketingModule`` consumes the policy at ``switch_bucket`` time;
+  the io pipeline pads batches into the chosen bucket
+  (:func:`mxnet_tpu.io.pad_batch_to_bucket`).
+
+Env knobs (see docs/env_vars.md):
+
+* ``MXNET_TPU_CACHE_DIR`` — enables the on-disk layer (and jax's
+  persistent cache under ``<dir>/xla``) at first use.
+* ``MXNET_TPU_CACHE=0`` — disables all program caching (memory too).
+* ``MXNET_TPU_CACHE_MAX_ENTRIES`` — in-process LRU capacity (default 64).
+* ``MXNET_TPU_BUCKET_POLICY`` — default bucket ladder as
+  ``min:factor:round`` (e.g. ``16:2.0:16``).
+* ``MXNET_TPU_MAX_BUCKETS`` — runaway-recompilation warning threshold.
+"""
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+import pickle
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+
+from .base import MXNetError
+
+__all__ = ["ProgramCache", "CacheKey", "program_key", "describe_avals",
+           "mesh_fingerprint", "get_cache", "configure",
+           "enable_persistent_cache", "BucketPolicy", "plan_shape_buckets",
+           "bucket_for", "pad_to_bucket"]
+
+_log = logging.getLogger(__name__)
+
+ENV_CACHE_DIR = "MXNET_TPU_CACHE_DIR"
+ENV_CACHE = "MXNET_TPU_CACHE"
+ENV_CACHE_MAX_ENTRIES = "MXNET_TPU_CACHE_MAX_ENTRIES"
+ENV_BUCKET_POLICY = "MXNET_TPU_BUCKET_POLICY"
+ENV_MAX_BUCKETS = "MXNET_TPU_MAX_BUCKETS"
+
+
+# ---------------------------------------------------------------------------
+# Cache keys
+# ---------------------------------------------------------------------------
+
+def _versions() -> str:
+    try:
+        import jaxlib
+        jl = getattr(jaxlib, "__version__", "?")
+    except Exception:
+        jl = "?"
+    return f"jax={jax.__version__};jaxlib={jl}"
+
+
+def describe_avals(tree) -> str:
+    """Canonical string for a pytree of array-likes: per leaf
+    ``(path, shape, dtype, sharding)``.  Shardings matter — the same
+    jaxpr partitioned differently is a different executable."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    parts = []
+    for i, leaf in enumerate(leaves):
+        shape = tuple(getattr(leaf, "shape", ()))
+        dtype = str(getattr(leaf, "dtype", type(leaf).__name__))
+        sh = getattr(leaf, "sharding", None)
+        parts.append(f"{i}:{shape}:{dtype}:{sh}")
+    return f"{treedef}|" + ";".join(parts)
+
+
+def mesh_fingerprint(mesh) -> str:
+    """Mesh identity for the key: axis names/sizes + device kinds + ids.
+    Two meshes with the same shape over different chips compile to
+    different (and non-interchangeable) executables."""
+    if mesh is None:
+        return "mesh=None"
+    devs = list(np.asarray(mesh.devices).flat)
+    kinds = sorted({getattr(d, "device_kind", "?") for d in devs})
+    ids = tuple(getattr(d, "id", -1) for d in devs)
+    return (f"axes={tuple(mesh.axis_names)};shape={tuple(mesh.devices.shape)};"
+            f"kinds={kinds};ids={ids}")
+
+
+class CacheKey:
+    """Hashable identity of one compiled program.  ``digest`` is the
+    sha256 over every field; ``fields`` stay readable so the inspect
+    tool can show what a key was made of."""
+
+    def __init__(self, fields: Dict[str, str]):
+        self.fields = dict(fields)
+        h = hashlib.sha256()
+        for k in sorted(self.fields):
+            h.update(k.encode())
+            h.update(b"\x00")
+            h.update(str(self.fields[k]).encode())
+            h.update(b"\x01")
+        self.digest = h.hexdigest()
+
+    def __hash__(self):
+        return hash(self.digest)
+
+    def __eq__(self, other):
+        return isinstance(other, CacheKey) and other.digest == self.digest
+
+    def __repr__(self):
+        return f"CacheKey({self.digest[:12]})"
+
+    def describe(self) -> Dict[str, str]:
+        return dict(self.fields)
+
+
+def program_key(fingerprint: str, avals=None, donate: Sequence[int] = (),
+                mesh=None, backend: Optional[str] = None,
+                extra: Optional[Dict[str, Any]] = None) -> CacheKey:
+    """Build the :class:`CacheKey` for one program.
+
+    ``fingerprint`` is the graph identity (use
+    :func:`mxnet_tpu.graph_eval.graph_fingerprint` for symbols);
+    ``avals`` a pytree of the call arguments (arrays or
+    ``ShapeDtypeStruct``; shardings are read off the leaves); ``donate``
+    the donated argnums.  Backend defaults to jax's default backend.
+    """
+    fields = {
+        "fingerprint": str(fingerprint),
+        "avals": describe_avals(avals) if avals is not None else "",
+        "donate": str(tuple(donate)),
+        "mesh": mesh_fingerprint(mesh),
+        "backend": backend or jax.default_backend(),
+        "versions": _versions(),
+    }
+    for k, v in (extra or {}).items():
+        fields[f"x:{k}"] = str(v)
+    return CacheKey(fields)
+
+
+# ---------------------------------------------------------------------------
+# Program cache
+# ---------------------------------------------------------------------------
+
+def _atomic_write(path: str, payload: bytes) -> None:
+    tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+    with open(tmp, "wb") as f:
+        f.write(payload)
+    os.replace(tmp, path)
+
+
+class ProgramCache:
+    """LRU of compiled executables with an optional on-disk layer.
+
+    Memory entries hold live ``jax.stages.Compiled`` objects; disk
+    entries hold ``serialize_executable`` payloads written atomically
+    (tmp + ``os.replace``) next to a JSON sidecar with the key fields —
+    the unit the inspect tool lists/evicts.  Lookup order: memory ->
+    disk -> compile.  Every resolution is recorded in ``stats`` and as a
+    profiler compile event (:func:`mxnet_tpu.profiler.record_compile`).
+    """
+
+    def __init__(self, cache_dir: Optional[str] = None,
+                 max_entries: int = 64, enabled: bool = True):
+        self.cache_dir = cache_dir
+        self.max_entries = max(1, int(max_entries))
+        self.enabled = enabled
+        self._mem: "OrderedDict[str, Any]" = OrderedDict()
+        self._lock = threading.RLock()
+        self._disk_broken = False
+        self.stats = {"memory_hits": 0, "disk_hits": 0, "misses": 0,
+                      "puts": 0, "disk_errors": 0}
+
+    # -- paths ----------------------------------------------------------
+
+    def _progdir(self) -> Optional[str]:
+        if self.cache_dir is None or self._disk_broken:
+            return None
+        d = os.path.join(self.cache_dir, "programs")
+        try:
+            os.makedirs(d, exist_ok=True)
+        except OSError as e:
+            _log.warning("program cache dir %s unusable (%s); disk layer off",
+                         d, e)
+            self._disk_broken = True
+            return None
+        return d
+
+    def _paths(self, digest: str) -> Tuple[Optional[str], Optional[str]]:
+        d = self._progdir()
+        if d is None:
+            return None, None
+        return os.path.join(d, f"{digest}.bin"), os.path.join(d, f"{digest}.json")
+
+    # -- core -----------------------------------------------------------
+
+    def lookup(self, key: CacheKey):
+        """Memory then disk; returns a callable Compiled or None.
+        Remembers which layer answered in ``_last_source``."""
+        if not self.enabled:
+            return None
+        with self._lock:
+            ent = self._mem.get(key.digest)
+            if ent is not None:
+                self._mem.move_to_end(key.digest)
+                self.stats["memory_hits"] += 1
+                self._last_source = "memory"
+                return ent
+        compiled = self._disk_load(key)
+        if compiled is not None:
+            self._mem_put(key.digest, compiled)
+            self.stats["disk_hits"] += 1
+        return compiled
+
+    def put(self, key: CacheKey, compiled, label: str = "",
+            compile_seconds: float = 0.0) -> None:
+        if not self.enabled:
+            return
+        self._mem_put(key.digest, compiled)
+        self.stats["puts"] += 1
+        self._disk_store(key, compiled, label, compile_seconds)
+
+    def get_or_compile(self, key: CacheKey, compile_fn: Callable[[], Any],
+                       label: str = "") -> Tuple[Any, Dict[str, Any]]:
+        """Resolve ``key`` -> compiled program.  ``compile_fn`` runs only
+        on a full miss.  Returns ``(compiled, info)`` with
+        ``info["source"]`` in memory/disk/compile and ``info["seconds"]``
+        the time that resolution took."""
+        t0 = time.perf_counter()
+        compiled = self.lookup(key)
+        if compiled is not None:
+            info = {"source": self._last_source, "seconds":
+                    time.perf_counter() - t0, "digest": key.digest}
+            self._record(label, info)
+            return compiled, info
+        compiled = compile_fn()
+        seconds = time.perf_counter() - t0
+        self.stats["misses"] += 1
+        self.put(key, compiled, label=label, compile_seconds=seconds)
+        info = {"source": "compile", "seconds": seconds,
+                "digest": key.digest}
+        self._record(label, info)
+        return compiled, info
+
+    def _record(self, label: str, info: Dict[str, Any]) -> None:
+        from . import profiler
+        profiler.record_compile(label or "program", info["seconds"],
+                                source=info["source"],
+                                digest=info["digest"])
+
+    def _mem_put(self, digest: str, compiled) -> None:
+        with self._lock:
+            self._mem[digest] = compiled
+            self._mem.move_to_end(digest)
+            while len(self._mem) > self.max_entries:
+                self._mem.popitem(last=False)
+
+    # -- disk layer ------------------------------------------------------
+
+    def _disk_load(self, key: CacheKey):
+        self._last_source = "disk"
+        binp, _ = self._paths(key.digest)
+        if binp is None or not os.path.exists(binp):
+            return None
+        try:
+            from jax.experimental import serialize_executable
+            with open(binp, "rb") as f:
+                payload, in_tree, out_tree = pickle.load(f)
+            return serialize_executable.deserialize_and_load(
+                payload, in_tree, out_tree)
+        except Exception as e:
+            self.stats["disk_errors"] += 1
+            _log.warning("program cache: failed to load %s (%s) — treating "
+                         "as a miss", key.digest[:12], e)
+            return None
+
+    def _disk_store(self, key: CacheKey, compiled, label: str,
+                    compile_seconds: float) -> None:
+        binp, metap = self._paths(key.digest)
+        if binp is None:
+            return
+        try:
+            from jax.experimental import serialize_executable
+            payload, in_tree, out_tree = serialize_executable.serialize(
+                compiled)
+            _atomic_write(binp, pickle.dumps((payload, in_tree, out_tree)))
+            import json
+            meta = {"digest": key.digest, "label": label,
+                    "compile_seconds": round(compile_seconds, 4),
+                    "created": time.time(),
+                    "payload_bytes": os.path.getsize(binp),
+                    "fields": key.describe()}
+            _atomic_write(metap, json.dumps(meta, indent=1).encode())
+        except Exception as e:
+            self.stats["disk_errors"] += 1
+            _log.debug("program cache: could not persist %s (%s)",
+                       key.digest[:12], e)
+
+    # overwritten per lookup so get_or_compile can report memory vs disk
+    _last_source = "disk"
+
+    # -- maintenance -----------------------------------------------------
+
+    def clear_memory(self) -> None:
+        """Drop the in-process LRU (disk entries survive — the warm
+        restart simulation bench --compile uses)."""
+        with self._lock:
+            self._mem.clear()
+
+    def clear(self) -> None:
+        self.clear_memory()
+        d = self._progdir()
+        if d is None:
+            return
+        for name in os.listdir(d):
+            if name.endswith((".bin", ".json")):
+                try:
+                    os.remove(os.path.join(d, name))
+                except OSError:
+                    pass
+
+    def entries(self) -> List[Dict[str, Any]]:
+        """Disk-entry metadata (one dict per persisted program)."""
+        d = self._progdir()
+        out = []
+        if d is None:
+            return out
+        import json
+        for name in sorted(os.listdir(d)):
+            if not name.endswith(".json"):
+                continue
+            try:
+                with open(os.path.join(d, name)) as f:
+                    out.append(json.load(f))
+            except Exception:
+                continue
+        return out
+
+    def evict(self, digest: str) -> bool:
+        """Remove one disk entry (and its memory copy) by digest prefix."""
+        removed = False
+        with self._lock:
+            for full in [k for k in self._mem if k.startswith(digest)]:
+                del self._mem[full]
+                removed = True
+        d = self._progdir()
+        if d is not None:
+            for name in os.listdir(d):
+                if name.startswith(digest) and name.endswith((".bin", ".json")):
+                    try:
+                        os.remove(os.path.join(d, name))
+                        removed = True
+                    except OSError:
+                        pass
+        return removed
+
+
+# ---------------------------------------------------------------------------
+# Global cache singleton + jax persistent-cache wiring
+# ---------------------------------------------------------------------------
+
+_global: Dict[str, Any] = {"cache": None}
+_glock = threading.Lock()
+
+
+def enable_persistent_cache(cache_dir: str) -> None:
+    """Point jax's own HLO-keyed compilation cache at
+    ``<cache_dir>/xla`` and drop the size/time thresholds so every
+    program persists (CPU compiles are fast but the restart still pays
+    them without this)."""
+    xla_dir = os.path.join(cache_dir, "xla")
+    os.makedirs(xla_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", xla_dir)
+    for knob, val in (("jax_persistent_cache_min_entry_size_bytes", -1),
+                      ("jax_persistent_cache_min_compile_time_secs", 0.0)):
+        try:
+            jax.config.update(knob, val)
+        except Exception:  # knob absent on this jax version
+            pass
+
+
+def configure(cache_dir: Optional[str] = None,
+              max_entries: Optional[int] = None,
+              enabled: Optional[bool] = None,
+              wire_jax_cache: bool = True) -> ProgramCache:
+    """(Re)build the global :class:`ProgramCache`.  With ``cache_dir``
+    the disk layer turns on and (unless ``wire_jax_cache=False``) jax's
+    persistent cache is pointed under the same root."""
+    with _glock:
+        cur = _global["cache"]
+        cache = ProgramCache(
+            cache_dir=cache_dir,
+            max_entries=(max_entries if max_entries is not None
+                         else (cur.max_entries if cur else 64)),
+            enabled=(enabled if enabled is not None else True))
+        if cache_dir and wire_jax_cache and cache.enabled:
+            try:
+                enable_persistent_cache(cache_dir)
+            except Exception as e:
+                _log.warning("could not enable jax persistent cache: %s", e)
+        _global["cache"] = cache
+        return cache
+
+
+def get_cache() -> ProgramCache:
+    """Global cache, auto-configured from the environment on first use."""
+    with _glock:
+        if _global["cache"] is None:
+            enabled = os.environ.get(ENV_CACHE, "1") != "0"
+            cache_dir = os.environ.get(ENV_CACHE_DIR) or None
+            max_entries = int(os.environ.get(ENV_CACHE_MAX_ENTRIES, "64"))
+            cache = ProgramCache(cache_dir=cache_dir if enabled else None,
+                                 max_entries=max_entries, enabled=enabled)
+            if enabled and cache_dir:
+                try:
+                    enable_persistent_cache(cache_dir)
+                except Exception as e:
+                    _log.warning("could not enable jax persistent cache: %s",
+                                 e)
+            _global["cache"] = cache
+        return _global["cache"]
+
+
+# ---------------------------------------------------------------------------
+# Bucket-shape canonicalization
+# ---------------------------------------------------------------------------
+
+def _round_up(x: int, to: int) -> int:
+    return -(-int(x) // int(to)) * int(to)
+
+
+class BucketPolicy:
+    """Geometric padded-bucket ladder for dynamic shapes.
+
+    ``bucket_of(length)`` is CLOSED FORM and data-independent: the
+    smallest ladder value ``>= length`` where the ladder starts at
+    ``min_bucket`` and multiplies by ``factor`` (each rung rounded up to
+    a multiple of ``round_to``).  Deterministic canonicalization means a
+    stream of lengths never re-plans (and never re-compiles) as new
+    lengths show up.  Pass ``buckets=[...]`` to pin an explicit set
+    instead (e.g. the output of :func:`plan_shape_buckets`).
+
+    ``round_to`` should match the attention block size when bitwise
+    padded-loss parity matters: blockwise attention with a fixed block
+    processes padded tail blocks as exact no-ops (see docs/perf.md r7).
+
+    ``axis`` is the padded dimension of the batch arrays (1 for
+    ``[batch, seq]`` token ids); ``pad_value``/``label_pad`` fill data /
+    label padding (point ``label_pad`` at the loss head's
+    ``ignore_label`` so padded positions drop out of loss and metrics).
+    """
+
+    def __init__(self, min_bucket: int = 16, factor: float = 2.0,
+                 max_buckets: int = 8, round_to: int = 16, axis: int = 1,
+                 pad_value=0, label_pad=None,
+                 buckets: Optional[Sequence[int]] = None):
+        if factor <= 1.0:
+            raise MXNetError(f"BucketPolicy factor must be > 1, got {factor}")
+        if min_bucket < 1 or round_to < 1:
+            raise MXNetError("BucketPolicy min_bucket/round_to must be >= 1")
+        self.min_bucket = int(min_bucket)
+        self.factor = float(factor)
+        self.max_buckets = int(max_buckets)
+        self.round_to = int(round_to)
+        self.axis = int(axis)
+        self.pad_value = pad_value
+        self.label_pad = label_pad if label_pad is not None else pad_value
+        self.buckets = sorted(int(b) for b in buckets) if buckets else None
+
+    @classmethod
+    def from_env(cls, **kwargs) -> "BucketPolicy":
+        """Build from ``MXNET_TPU_BUCKET_POLICY=min:factor:round`` (+
+        ``MXNET_TPU_MAX_BUCKETS``); explicit kwargs win."""
+        spec = os.environ.get(ENV_BUCKET_POLICY, "")
+        if spec:
+            parts = spec.split(":")
+            try:
+                if len(parts) >= 1 and parts[0]:
+                    kwargs.setdefault("min_bucket", int(parts[0]))
+                if len(parts) >= 2 and parts[1]:
+                    kwargs.setdefault("factor", float(parts[1]))
+                if len(parts) >= 3 and parts[2]:
+                    kwargs.setdefault("round_to", int(parts[2]))
+            except ValueError:
+                raise MXNetError(
+                    f"bad {ENV_BUCKET_POLICY}={spec!r} (want min:factor:round)")
+        mb = os.environ.get(ENV_MAX_BUCKETS)
+        if mb:
+            kwargs.setdefault("max_buckets", int(mb))
+        return cls(**kwargs)
+
+    def _ladder(self, upto: int) -> List[int]:
+        rungs = [_round_up(self.min_bucket, self.round_to)]
+        while rungs[-1] < upto:
+            nxt = _round_up(max(rungs[-1] + 1,
+                                int(rungs[-1] * self.factor)), self.round_to)
+            rungs.append(nxt)
+        return rungs
+
+    def bucket_of(self, length: int) -> int:
+        length = int(length)
+        if length < 1:
+            raise MXNetError(f"bucket_of: length must be >= 1, got {length}")
+        if self.buckets is not None:
+            return bucket_for(length, self.buckets)
+        return self._ladder(length)[-1]
+
+    def __repr__(self):
+        if self.buckets is not None:
+            return f"BucketPolicy(buckets={self.buckets})"
+        return (f"BucketPolicy(min={self.min_bucket}, factor={self.factor}, "
+                f"round_to={self.round_to}, max_buckets={self.max_buckets})")
+
+
+def bucket_for(length: int, buckets: Sequence[int]) -> int:
+    """Smallest bucket >= length from an explicit sorted set."""
+    for b in sorted(buckets):
+        if b >= length:
+            return int(b)
+    raise MXNetError(
+        f"length {length} exceeds the largest bucket {max(buckets)}")
+
+
+def plan_shape_buckets(lengths: Sequence[int],
+                       policy: Optional[BucketPolicy] = None) -> List[int]:
+    """Round ``lengths`` onto the policy's geometric ladder and return
+    the sorted bucket set actually used.  If the set exceeds
+    ``policy.max_buckets`` the factor widens geometrically until it
+    fits, so dozens of distinct lengths always collapse into a small
+    program set (pad waste grows instead — the documented trade)."""
+    if policy is None:
+        policy = BucketPolicy.from_env()
+    if not lengths:
+        return []
+    pol = policy
+    for _ in range(32):
+        buckets = sorted({pol.bucket_of(l) for l in lengths})
+        if len(buckets) <= pol.max_buckets:
+            if pol is not policy:
+                _log.warning(
+                    "plan_shape_buckets: widened factor %.2f -> %.2f to fit "
+                    "%d lengths into %d buckets", policy.factor, pol.factor,
+                    len(set(lengths)), pol.max_buckets)
+            return buckets
+        pol = BucketPolicy(min_bucket=pol.min_bucket,
+                           factor=pol.factor * 1.5,
+                           max_buckets=pol.max_buckets,
+                           round_to=pol.round_to, axis=pol.axis,
+                           pad_value=pol.pad_value,
+                           label_pad=pol.label_pad)
+    return buckets  # pragma: no cover — factor growth always terminates
+
+
+def pad_to_bucket(arr, bucket: int, axis: int = 1, pad_value=0):
+    """Pad one array along ``axis`` up to ``bucket`` (host numpy in,
+    host numpy out; no-op when already at the bucket size)."""
+    a = np.asarray(arr)
+    if axis >= a.ndim:
+        raise MXNetError(
+            f"pad_to_bucket: axis {axis} out of range for shape {a.shape}")
+    cur = a.shape[axis]
+    if cur > bucket:
+        raise MXNetError(
+            f"pad_to_bucket: length {cur} exceeds bucket {bucket}")
+    if cur == bucket:
+        return a
+    cfg = [(0, 0)] * a.ndim
+    cfg[axis] = (0, bucket - cur)
+    return np.pad(a, cfg, constant_values=pad_value)

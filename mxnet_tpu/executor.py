@@ -44,6 +44,25 @@ from .ops.registry import OpContext
 __all__ = ["Executor"]
 
 
+class _AotProgram:
+    """Callable installed into an executor's program cache by
+    :meth:`Executor.warmup`: dispatches the AOT-compiled executable
+    directly, falling back to the jit path on an aval mismatch (which
+    raises before execution, so the fallback is always safe)."""
+
+    __slots__ = ("_compiled", "_jit_fn")
+
+    def __init__(self, compiled, jit_fn):
+        self._compiled = compiled
+        self._jit_fn = jit_fn
+
+    def __call__(self, *args):
+        try:
+            return self._compiled(*args)
+        except (TypeError, ValueError):
+            return self._jit_fn(*args)
+
+
 def _as_req_dict(grad_req, arg_names: List[str]) -> Dict[str, str]:
     if isinstance(grad_req, str):
         return {n: grad_req for n in arg_names}
@@ -241,6 +260,76 @@ class Executor:
 
             return run
         return self._prog("fb_" + ",".join(self._grad_names), build)
+
+    # ------------------------------------------------------------------
+    # AOT warmup (compile_cache integration)
+    # ------------------------------------------------------------------
+
+    def program_cache_size(self) -> int:
+        """Number of compiled programs in this executor's (possibly
+        shared) cache — the bucketing reuse gauge."""
+        return len(self._cache)
+
+    def _fingerprint(self) -> str:
+        if getattr(self, "_graph_fp", None) is None:
+            from .graph_eval import graph_fingerprint
+            self._graph_fp = graph_fingerprint(self._symbol, topo=self._topo)
+        return self._graph_fp
+
+    def warmup(self, fb: Optional[bool] = None) -> List[Dict[str, Any]]:
+        """Eagerly compile this executor's programs through the global
+        :class:`~mxnet_tpu.compile_cache.ProgramCache` instead of waiting
+        for the first batch: the inference forward, and (when gradients
+        are bound, or ``fb=True``) the fused forward+backward.
+
+        Resolved executables are installed into the program cache wrapped
+        in :class:`_AotProgram` — subsequent ``forward``/``backward``
+        calls dispatch them directly, with automatic jit fallback on a
+        shape change.  Returns the per-program resolution info
+        (``source``: memory/disk/compile, ``seconds``).  Eagerly-placed
+        executors (``group2ctx`` / host-callback pinning) have no
+        compiled programs and return ``[]``.
+        """
+        if self._placement is not None:
+            return []
+        from . import compile_cache as cc
+        sds = jax.ShapeDtypeStruct
+        arg_avals = {n: sds(a.shape, jnp.dtype(a.dtype))
+                     for n, a in self._arg_dict.items()}
+        aux_avals = {n: sds(a.shape, jnp.dtype(a.dtype))
+                     for n, a in self._aux_dict.items()}
+        rng = self._next_rng()
+        rng_aval = sds(rng.shape, rng.dtype)
+        dev = str(self._ctx.jax_device)
+        infos: List[Dict[str, Any]] = []
+        cache = cc.get_cache()
+
+        def warm(prog_key: str, jit_fn, in_args, extra):
+            ckey = cc.program_key(self._fingerprint(), in_args,
+                                  extra=dict(extra, device=dev))
+            compiled, info = cache.get_or_compile(
+                ckey, lambda: jit_fn.lower(*in_args).compile(),
+                label=f"executor.{prog_key}")
+            self._cache[(id(self._symbol), prog_key)] = (
+                self._symbol, _AotProgram(compiled, jit_fn))
+            infos.append(dict(info, kind=prog_key))
+
+        warm("fwd_False", self._get_fwd(False),
+             (arg_avals, aux_avals, rng_aval), {"kind": "fwd_False"})
+        if fb or (fb is None and self._grad_names):
+            if not self._grad_names:
+                raise MXNetError("warmup(fb=True) on an executor bound "
+                                 "without gradient arrays")
+            # training forwards dispatch the is_train=True program
+            # (train-mode ops: dropout live, BN batch stats)
+            warm("fwd_True", self._get_fwd(True),
+                 (arg_avals, aux_avals, rng_aval), {"kind": "fwd_True"})
+            out_grads = tuple(sds(s, jnp.float32)
+                              for s in self._infer_head_shapes())
+            warm("fb_" + ",".join(self._grad_names), self._get_fb(),
+                 (arg_avals, aux_avals, rng_aval, out_grads),
+                 {"kind": "fb", "grads": ",".join(self._grad_names)})
+        return infos
 
     # ------------------------------------------------------------------
     # Public API (reference executor.py)

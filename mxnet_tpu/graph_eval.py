@@ -19,7 +19,37 @@ import jax
 
 from .ops.registry import OpContext
 
-__all__ = ["eval_symbol"]
+__all__ = ["eval_symbol", "graph_fingerprint"]
+
+
+def graph_fingerprint(symbol, topo=None) -> str:
+    """Stable structural identity of a symbol graph, for compile-cache
+    keys (:func:`mxnet_tpu.compile_cache.program_key`).
+
+    Hashes, in topological order: each node's op name, its parsed
+    parameters, its annotation attrs (``remat_scope`` etc. change the
+    traced program), and its input wiring as topo indices, plus the head
+    entries.  Two graphs with the same fingerprint trace to the same
+    jaxpr for the same avals; any op/param/wiring change produces a new
+    fingerprint.  Node *names* are excluded — renamed-but-identical
+    graphs share programs.
+    """
+    import hashlib
+    if topo is None:
+        topo = symbol._topo()
+    gidx = {id(n): i for i, n in enumerate(topo)}
+    h = hashlib.sha256()
+    for n in topo:
+        if n.is_variable:
+            h.update(b"var\x00")
+            continue
+        h.update(n.op.name.encode())
+        h.update(repr(sorted(n.parsed_params().items())).encode())
+        h.update(repr(sorted(n.anno_attrs().items())).encode())
+        h.update(repr([(gidx[id(s)], k) for (s, k) in n.inputs]).encode())
+        h.update(b"\x00")
+    h.update(repr([(gidx[id(n)], i) for (n, i) in symbol._heads]).encode())
+    return h.hexdigest()
 
 
 def eval_symbol(symbol, arg_vals: Dict[str, jax.Array],

@@ -27,7 +27,7 @@ from .ndarray import NDArray, array as nd_array
 
 __all__ = ["DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
            "PrefetchingIter", "DevicePrefetchIter", "CSVIter", "MNISTIter",
-           "DataDesc"]
+           "DataDesc", "pad_batch_to_bucket"]
 
 
 DataDesc = namedtuple("DataDesc", ["name", "shape"])
@@ -48,6 +48,53 @@ class DataBatch:
         self.bucket_key = bucket_key
         self.provide_data = provide_data
         self.provide_label = provide_label
+
+
+def pad_batch_to_bucket(batch: DataBatch, bucket: int, axis: int = 1,
+                        pad_value=0, label_pad=None) -> DataBatch:
+    """Pad a :class:`DataBatch`'s arrays along ``axis`` up to ``bucket``
+    and return a NEW batch carrying ``bucket_key=bucket`` — the io-side
+    half of bucket-shape canonicalization (see
+    :class:`mxnet_tpu.compile_cache.BucketPolicy`).
+
+    Data arrays pad with ``pad_value``; label arrays with ``label_pad``
+    (default ``pad_value``) — point ``label_pad`` at the loss head's
+    ``ignore_label`` so padded positions contribute exactly zero to loss
+    and metrics.  Arrays without dim ``axis``, or already at the bucket
+    size, pass through unchanged.  ``provide_data``/``provide_label``
+    are rewritten to the padded shapes.
+    """
+    from .compile_cache import pad_to_bucket
+    if label_pad is None:
+        label_pad = pad_value
+
+    def pad_list(arrs, fill):
+        out = []
+        for a in arrs or []:
+            host = a.asnumpy() if isinstance(a, NDArray) else np.asarray(a)
+            if axis < host.ndim and host.shape[axis] != bucket:
+                out.append(nd_array(pad_to_bucket(host, bucket, axis=axis,
+                                                  pad_value=fill)))
+            else:
+                out.append(a if isinstance(a, NDArray) else nd_array(host))
+        return out
+
+    def pad_desc(descs, arrs):
+        if descs is None:
+            return None
+        out = []
+        for d, a in zip(descs, arrs):
+            name, shape = d[0], tuple(a.shape)
+            out.append(type(d)(name, shape) if isinstance(d, DataDesc)
+                       else (name, shape) + tuple(d[2:]))
+        return out
+
+    data = pad_list(batch.data, pad_value)
+    label = pad_list(batch.label, label_pad)
+    return DataBatch(data=data, label=label, pad=batch.pad,
+                     index=batch.index, bucket_key=bucket,
+                     provide_data=pad_desc(batch.provide_data, data),
+                     provide_label=pad_desc(batch.provide_label, label))
 
 
 class DataIter:

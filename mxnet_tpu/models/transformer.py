@@ -63,7 +63,7 @@ def transformer_block(x, b, l, d, heads, name, causal=True,
 def transformer_lm(vocab_size=256, num_layers=2, d_model=64, heads=4,
                    batch_size=8, seq_len=64, causal=True, remat=False,
                    head_same_dtype=False, loss_head=False,
-                   attn_block_size=0):
+                   attn_block_size=0, ignore_label=None):
     """Build the LM symbol; inputs ``data``/``softmax_label`` are
     ``[batch, seq]`` token ids.  ``remat=True`` wraps each block in a
     ``remat_scope`` so backward recomputes the block from its boundary
@@ -75,7 +75,11 @@ def transformer_lm(vocab_size=256, num_layers=2, d_model=64, heads=4,
     TRAINING head: the symbol's output is the per-token cross-entropy
     ([B*L], f32) and no [B*L, vocab] probability tensor is emitted at
     all — gradients are identical to the parity head (use the default
-    probs head for eval/predict)."""
+    probs head for eval/predict).  ``ignore_label`` masks positions
+    whose label equals it out of the loss AND its gradient (×1.0 at
+    every valid position, so masked and unmasked runs agree bitwise at
+    valid positions) — the correctness mask for bucket-padded batches
+    (compile_cache.BucketPolicy / io.pad_batch_to_bucket)."""
     b, l, d = batch_size, seq_len, d_model
     net = sym.Embedding(data=sym.Variable("data"), input_dim=vocab_size,
                         output_dim=d, name="embed")
@@ -90,6 +94,10 @@ def transformer_lm(vocab_size=256, num_layers=2, d_model=64, heads=4,
     net = sym.Reshape(data=net, shape=(b * l, d))
     net = sym.FullyConnected(data=net, num_hidden=vocab_size, name="lm_head")
     label = sym.Reshape(data=sym.Variable("softmax_label"), shape=(b * l,))
+    head_kwargs = {}
+    if ignore_label is not None:
+        head_kwargs = dict(use_ignore=True, ignore_label=ignore_label)
     return sym.SoftmaxOutput(data=net, label=label, name="softmax",
                              out_dtype="same" if head_same_dtype else "",
-                             out_mode="loss" if loss_head else "")
+                             out_mode="loss" if loss_head else "",
+                             **head_kwargs)

@@ -10,7 +10,10 @@ trick maps to the shared compile cache + shared param NDArrays.
 from __future__ import annotations
 
 import logging
-from typing import Any, Dict, Optional
+import os
+from typing import Any, Dict, List, Optional
+
+import numpy as np
 
 from ..base import MXNetError
 from ..initializer import Uniform
@@ -21,10 +24,29 @@ __all__ = ["BucketingModule"]
 
 
 class BucketingModule(BaseModule):
-    """(reference ``bucketing_module.py:16``)"""
+    """(reference ``bucketing_module.py:16``)
+
+    TPU-specific extensions over the reference:
+
+    * ``bucket_policy`` (a :class:`mxnet_tpu.compile_cache.BucketPolicy`)
+      turns on bucket-shape canonicalization: integer bucket keys round
+      UP onto the policy's geometric ladder and :meth:`forward` pads the
+      batch into the chosen bucket (data with ``policy.pad_value``,
+      labels with ``policy.label_pad`` — point it at the loss's
+      ``ignore_label`` for a masked, bitwise-clean loss).  Dozens of
+      distinct sequence lengths then compile ~4-8 programs instead of
+      one each.
+    * ``max_buckets`` (default ``MXNET_TPU_MAX_BUCKETS`` or 16) is the
+      runaway-recompilation detector: binding more distinct buckets than
+      this logs a warning naming the fix (a bucket_policy).
+    * :meth:`cache_report` exposes bucket/program/switch counters and
+      :meth:`compile` AOT-warms a list of bucket keys through the
+      persistent program cache.
+    """
 
     def __init__(self, sym_gen, default_bucket_key=None, logger=logging,
-                 context=None, work_load_list=None):
+                 context=None, work_load_list=None, bucket_policy=None,
+                 max_buckets: Optional[int] = None):
         super().__init__(logger=logger)
         assert default_bucket_key is not None
         self._default_bucket_key = default_bucket_key
@@ -33,11 +55,29 @@ class BucketingModule(BaseModule):
         self._work_load_list = work_load_list
         self._buckets: Dict[Any, Module] = {}
         self._curr_module: Optional[Module] = None
+        self._bucket_policy = bucket_policy
+        if max_buckets is None:
+            max_buckets = int(os.environ.get("MXNET_TPU_MAX_BUCKETS", "16"))
+        self._max_buckets = int(max_buckets)
+        self._switch_count = 0
+        self._switch_hits = 0
+        self._warned_runaway = False
 
     def _reset_bind(self):
         self.binded = False
         self._buckets = {}
         self._curr_module = None
+        self._switch_count = 0
+        self._switch_hits = 0
+        self._warned_runaway = False
+
+    def _canonical_key(self, bucket_key):
+        """Round an integer bucket key up onto the policy ladder; other
+        key types (tuples, strings) pass through untouched."""
+        if self._bucket_policy is not None \
+                and isinstance(bucket_key, (int, np.integer)):
+            return self._bucket_policy.bucket_of(int(bucket_key))
+        return bucket_key
 
     @property
     def data_names(self):
@@ -124,9 +164,35 @@ class BucketingModule(BaseModule):
         self._curr_module = module
         self._buckets[self._default_bucket_key] = module
 
+    def _bucket_shapes(self, raw_key, bucket_key, shapes):
+        """Rewrite shape descs for a canonicalized key: every dim at the
+        policy axis that equals the raw key becomes the bucket size."""
+        if shapes is None or self._bucket_policy is None \
+                or raw_key == bucket_key:
+            return shapes
+        axis = self._bucket_policy.axis
+        out = []
+        for desc in shapes:
+            name, shape = desc[0], list(desc[1])
+            if axis < len(shape) and shape[axis] == int(raw_key):
+                shape[axis] = int(bucket_key)
+            out.append((name, tuple(shape)) + tuple(desc[2:]))
+        return out
+
     def switch_bucket(self, bucket_key, data_shapes, label_shapes=None):
-        """(reference ``bucketing_module.py:150``)"""
+        """(reference ``bucketing_module.py:150``)
+
+        With a ``bucket_policy``, integer keys canonicalize onto the
+        policy ladder first (and the shape descs' bucketed axis is
+        rewritten to match), so a stream of distinct lengths reuses the
+        small canonical program set instead of binding one module per
+        length."""
         assert self.binded, "call bind before switching bucket"
+        raw_key = bucket_key
+        bucket_key = self._canonical_key(bucket_key)
+        data_shapes = self._bucket_shapes(raw_key, bucket_key, data_shapes)
+        label_shapes = self._bucket_shapes(raw_key, bucket_key, label_shapes)
+        self._switch_count += 1
         if bucket_key not in self._buckets:
             symbol, data_names, label_names = self._call_sym_gen(bucket_key)
             module = Module(symbol, data_names, label_names,
@@ -137,7 +203,86 @@ class BucketingModule(BaseModule):
                         force_rebind=False,
                         shared_module=self._buckets[self._default_bucket_key])
             self._buckets[bucket_key] = module
+            if (len(self._buckets) > self._max_buckets
+                    and not self._warned_runaway):
+                self._warned_runaway = True
+                self.logger.warning(
+                    "BucketingModule bound %d distinct buckets "
+                    "(max_buckets=%d) — each bucket is a full shape-"
+                    "specialized XLA compilation; set a bucket_policy to "
+                    "canonicalize dynamic shapes onto a small padded "
+                    "ladder", len(self._buckets), self._max_buckets)
+        else:
+            self._switch_hits += 1
         self._curr_module = self._buckets[bucket_key]
+
+    def cache_report(self) -> Dict[str, int]:
+        """Program-reuse counters: ``buckets`` (bound modules ==
+        compiled shape specializations), ``programs`` (entries in the
+        shared executor program cache), ``switches``/``switch_hits``
+        (total switch_bucket calls / those that reused a bound
+        bucket)."""
+        assert self.binded
+        default = self._buckets[self._default_bucket_key]
+        return {"buckets": len(self._buckets),
+                "programs": default._exec_group.program_cache_size(),
+                "switches": self._switch_count,
+                "switch_hits": self._switch_hits}
+
+    def _shapes_for_key(self, key, descs):
+        """Derive an unbound bucket's shape descs from the default
+        bucket's: the bucketed dim (policy axis, else any non-batch dim
+        equal to the default key) becomes ``key``.  Int keys only."""
+        if descs is None:
+            return None
+        default = int(self._default_bucket_key)
+        axis = (self._bucket_policy.axis if self._bucket_policy is not None
+                else None)
+        out = []
+        for desc in descs:
+            name, shape = desc[0], list(desc[1])
+            if axis is not None:
+                if axis < len(shape) and shape[axis] == default:
+                    shape[axis] = int(key)
+            else:
+                shape = [int(key) if (i > 0 and s == default) else s
+                         for i, s in enumerate(shape)]
+            out.append((name, tuple(shape)) + tuple(desc[2:]))
+        return out
+
+    def compile(self, buckets: Optional[List[Any]] = None, fb=None):
+        """AOT-warm the programs for ``buckets`` (default: every bound
+        bucket) through the global program cache: each key is bound (via
+        :meth:`switch_bucket`, canonicalized under the policy, sharing
+        params with the default bucket) and its executor programs are
+        compiled eagerly.  Unbound int keys derive their shapes from the
+        default bucket's.  The current module is restored afterwards.
+        Returns the per-program resolution infos."""
+        assert self.binded, "call bind before compile"
+        prev = self._curr_module
+        keys = list(buckets) if buckets is not None \
+            else list(self._buckets.keys())
+        infos = []
+        try:
+            for key in keys:
+                ckey = self._canonical_key(key)
+                if ckey in self._buckets:
+                    self._curr_module = self._buckets[ckey]
+                elif isinstance(key, (int, np.integer)):
+                    default = self._buckets[self._default_bucket_key]
+                    self.switch_bucket(
+                        key, self._shapes_for_key(ckey, default.data_shapes),
+                        self._shapes_for_key(ckey, default.label_shapes))
+                else:
+                    raise MXNetError(
+                        f"compile: bucket {key!r} is not bound and its "
+                        "shapes cannot be derived (non-integer key) — "
+                        "switch_bucket it first")
+                for info in self._curr_module.compile(fb=fb):
+                    infos.append(dict(info, bucket=ckey))
+        finally:
+            self._curr_module = prev
+        return infos
 
     def init_optimizer(self, kvstore="local", optimizer="sgd",
                        optimizer_params=(("learning_rate", 0.01),),
@@ -155,6 +300,18 @@ class BucketingModule(BaseModule):
 
     def forward(self, data_batch, is_train=None):
         assert self.binded and self.params_initialized
+        pol = self._bucket_policy
+        key = data_batch.bucket_key
+        if pol is not None and isinstance(key, (int, np.integer)):
+            bucket = pol.bucket_of(int(key))
+            if bucket != int(key):
+                # canonicalize: pad the batch into the policy bucket
+                # (labels with label_pad == the loss head's ignore_label,
+                # so padded positions are masked out of loss/metrics)
+                from ..io import pad_batch_to_bucket
+                data_batch = pad_batch_to_bucket(
+                    data_batch, bucket, axis=pol.axis,
+                    pad_value=pol.pad_value, label_pad=pol.label_pad)
         self.switch_bucket(data_batch.bucket_key,
                            data_batch.provide_data,
                            data_batch.provide_label)

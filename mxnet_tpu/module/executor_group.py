@@ -147,6 +147,21 @@ class DataParallelExecutorGroup:
 
     # ------------------------------------------------------------------
 
+    def warmup(self, fb: Optional[bool] = None) -> List[Dict[str, Any]]:
+        """AOT-compile every executor's programs through the global
+        program cache (see :meth:`mxnet_tpu.executor.Executor.warmup`).
+        Returns the concatenated per-program resolution infos."""
+        infos: List[Dict[str, Any]] = []
+        for i, exec_ in enumerate(self.execs):
+            for info in exec_.warmup(fb=fb):
+                infos.append(dict(info, device=str(self.contexts[i])))
+        return infos
+
+    def program_cache_size(self) -> int:
+        """Compiled-program count in the (bucketing-shared) cache of the
+        first executor — the cross-bucket reuse gauge."""
+        return self.execs[0].program_cache_size() if self.execs else 0
+
     def set_params(self, arg_params, aux_params) -> None:
         for exec_ in self.execs:
             exec_.copy_params_from(arg_params, aux_params,

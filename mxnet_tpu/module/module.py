@@ -312,6 +312,14 @@ class Module(BaseModule):
             return data_batch
         return self._exec_group.stage_data_batch(data_batch)
 
+    def compile(self, fb=None):
+        """AOT warmup: compile this module's executor programs eagerly
+        through the global program cache instead of on the first batch
+        (see :meth:`mxnet_tpu.executor.Executor.warmup`).  Returns the
+        per-program resolution infos (``source``/``seconds``)."""
+        assert self.binded, "call bind() before compile()"
+        return self._exec_group.warmup(fb=fb)
+
     def forward(self, data_batch, is_train=None):
         assert self.binded and self.params_initialized
         self._exec_group.forward(data_batch, is_train)

@@ -63,6 +63,27 @@ def _attention_fwd(ctx, params, q, k, v):
                     lk)
         else:
             block = None
+
+    # ragged seq extents with an EXPLICIT causal block: pad q/k/v to the
+    # next block multiple and slice the output back.  Under the causal
+    # mask every padded key scores -inf for every valid query, which the
+    # online softmax turns into an exact no-op (exp underflows to 0.0,
+    # the running max/sum rescale by exp(0)=1.0) — so a ragged length
+    # computes the SAME blockwise reduction structure as its padded
+    # bucket, keeping bucketed and unpadded losses bitwise identical
+    # (docs/perf.md r7).
+    orig_len = None
+    if causal and block is not None and block > 0:
+        seq_dim = 1 if blhd else 2
+        seq_len = q.shape[seq_dim]
+        rem = seq_len % block
+        if rem:
+            import jax.numpy as jnp
+            orig_len = seq_len
+            cfg = [(0, 0)] * 4
+            cfg[seq_dim] = (0, block - rem)
+            q, k, v = (jnp.pad(t, cfg) for t in (q, k, v))
+
     if blhd:
         if block is not None:
             # [B, L, H, D] consumed without a symbol-level SwapAxis.
@@ -74,12 +95,15 @@ def _attention_fwd(ctx, params, q, k, v):
             # symbol; the native path switches on when Mosaic can
             # lower it (flash_attention.py:pallas_path).
             from ..parallel.flash_attention import flash_attention
-            return flash_attention(q, k, v, causal=causal, layout="blhd",
-                                   block_k=(block or None))
-        q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
-        out = local_attention(q, k, v, causal=causal, block_size=None)
-        return out.transpose(0, 2, 1, 3)
-    return local_attention(q, k, v, causal=causal, block_size=block)
+            out = flash_attention(q, k, v, causal=causal, layout="blhd",
+                                  block_k=(block or None))
+        else:
+            q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+            out = local_attention(q, k, v, causal=causal, block_size=None)
+            out = out.transpose(0, 2, 1, 3)
+        return out[:, :orig_len] if orig_len is not None else out
+    out = local_attention(q, k, v, causal=causal, block_size=block)
+    return out[:, :, :orig_len] if orig_len is not None else out
 
 
 def _attention_shape(params, in_shapes):

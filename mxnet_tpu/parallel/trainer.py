@@ -216,6 +216,13 @@ class ShardedTrainer:
                                              "eval": 0}
         self.strict_retrace = False
         self._train_sigs: List[Tuple] = []
+        # AOT-compiled programs from Trainer.compile (kind -> Compiled);
+        # step()/forward() dispatch through these when present, falling
+        # back to the jit path on any aval mismatch (a mismatch raises
+        # BEFORE donated buffers are consumed, so fallback is safe)
+        self._aot: Dict[str, Any] = {}
+        self.aot_stats: Dict[str, int] = {"hits": 0, "fallbacks": 0}
+        self.compile_info: List[Dict[str, Any]] = []
 
     def _multiproc(self) -> bool:
         if not hasattr(self, "_multiproc_cached"):
@@ -239,6 +246,18 @@ class ShardedTrainer:
         if self.matmul_precision is None:
             return contextlib.nullcontext()
         return jax.default_matmul_precision(self.matmul_precision)
+
+    def _set_base_key(self, key) -> None:
+        """Install the RNG base key with a PINNED placement (replicated on
+        this mesh) so a fresh bind and a checkpoint restore produce the
+        same jit signature — swapping the key never retraces."""
+        try:
+            typed = jnp.issubdtype(key.dtype, jax.dtypes.prng_key)
+        except Exception:
+            typed = False
+        if not typed:
+            key = self._global_put(jnp.asarray(key), replicated(self.mesh))
+        self._base_key = key
 
     # ------------------------------------------------------------------
     # Bind: infer shapes, initialize + place params, compile the step
@@ -491,19 +510,17 @@ class ShardedTrainer:
         base_wd = opt.wd
         needs_rng = type(opt)._needs_rng
 
-        # one base key captured at compile; per-step keys fold from the
-        # update counter INSIDE the program (no per-step host->device key
-        # transfer — each one is a round-trip on tunneled backends).  The
-        # key persists on the trainer so checkpoints can capture it:
-        # restore_state sets _base_key and recompiles, and every post-
-        # resume step folds the SAME stream it would have uninterrupted.
+        # per-step RNG keys fold from the update counter INSIDE the
+        # program (no per-step host->device key transfer — each one is a
+        # round-trip on tunneled backends), and the base key is a PROGRAM
+        # ARGUMENT rather than a closure constant: restore_state swaps
+        # ``self._base_key`` without retracing (the jit cache keys on the
+        # key's shape/dtype/sharding, which _set_base_key pins), and a
+        # persistent-cache executable stays valid across runs that resume
+        # with different keys.
         from .. import random as _random
         if getattr(self, "_base_key", None) is None:
-            self._base_key = _random._next_key()
-        base_key = self._base_key
-        # distinct stream for eval so eval-mode rng never correlates with
-        # the train step that shares a counter value
-        eval_key = jax.random.fold_in(base_key, 0x5EED)
+            self._set_base_key(_random._next_key())
 
         zero_shardings = {
             n: (NamedSharding(self.mesh, self._zero_specs[n])
@@ -539,7 +556,7 @@ class ShardedTrainer:
         if self.grad_compression is not None and self.data_axis is not None:
             _grads_and_heads = self._explicit_comm_grads(_grads_and_heads)
 
-        def train_step(params, aux, opt_state, batch, lr, t):
+        def train_step(params, aux, opt_state, batch, lr, t, base_key):
             rng = jax.random.fold_in(base_key, t)
 
             if accum > 1:
@@ -610,8 +627,10 @@ class ShardedTrainer:
             new_aux.update(auxu)
             return new_params, new_aux, new_opt, heads
 
-        def eval_step(params, aux, batch, t):
-            rng = jax.random.fold_in(eval_key, t)
+        def eval_step(params, aux, batch, t, base_key):
+            # distinct stream for eval so eval-mode rng never correlates
+            # with the train step that shares a counter value
+            rng = jax.random.fold_in(jax.random.fold_in(base_key, 0x5EED), t)
             if accum > 1:
                 # batch-baked symbols evaluate at the MICROBATCH size;
                 # map the graph over the k microbatches and restitch
@@ -661,9 +680,10 @@ class ShardedTrainer:
         # syncs).  jit is lazy — this never compiles unless fit() uses it.
         label_names = list(self._label_names)
 
-        def train_step_acc(params, aux, opt_state, batch, lr, t, carry):
+        def train_step_acc(params, aux, opt_state, batch, lr, t, carry,
+                           base_key):
             new_p, new_a, new_o, heads = train_step(params, aux, opt_state,
-                                                    batch, lr, t)
+                                                    batch, lr, t, base_key)
             c = carry
             for ln, head in zip(label_names, heads):
                 pred = head
@@ -681,6 +701,164 @@ class ShardedTrainer:
             _counted("train_acc", train_step_acc),
             out_shardings=(p_shard, a_shard, o_shard, None, None),
             donate_argnums=(0, 1, 2))
+        self._aot.clear()
+
+    # ------------------------------------------------------------------
+    # AOT warmup (compile_cache integration)
+    # ------------------------------------------------------------------
+
+    def _program_key(self, kind: str, in_avals):
+        """Cache key for one step program: graph fingerprint + call avals
+        + every trainer config that changes the traced computation."""
+        from .. import compile_cache as cc
+        from ..graph_eval import graph_fingerprint
+        if getattr(self, "_graph_fp", None) is None:
+            self._graph_fp = graph_fingerprint(self.symbol)
+        extra = {
+            "kind": kind,
+            "optimizer": type(self.optimizer).__name__,
+            "hyper": sorted(self.optimizer._hyper().items()),
+            "rescale_grad": self._rescale_grad,
+            "lr_mult": sorted(self._lr_mult.items()),
+            "wd_mult": sorted(self._wd_mult.items()),
+            "grad_accum": self.grad_accum,
+            "compute_dtype": str(self.compute_dtype),
+            "matmul_precision": self.matmul_precision,
+            "shard_optimizer": self.shard_optimizer,
+            "zero_specs": sorted((n, str(s))
+                                 for n, s in self._zero_specs.items()),
+            "grad_compression": self.grad_compression,
+            "grad_bucket_bytes": self.grad_bucket_bytes,
+            "data_axis": self.data_axis,
+            "rules": sorted((n, str(self.rules.spec_for(n)))
+                            for n in self._param_names),
+            "x64": bool(jax.config.jax_enable_x64),
+        }
+        donate = () if kind == "eval" else (0, 1, 2)
+        return cc.program_key(self._graph_fp, in_avals, donate=donate,
+                              mesh=self.mesh, extra=extra)
+
+    def compile(self, batch_spec=None, programs: Sequence[str] = ("train",),
+                background: bool = False):
+        """Ahead-of-time compile the step programs for known batch shapes
+        (``jit(...).lower(...).compile()``), resolving each through the
+        global :class:`~mxnet_tpu.compile_cache.ProgramCache` — a warm
+        restart attaches yesterday's executable from disk instead of
+        re-compiling.
+
+        ``batch_spec``: ``{input name: shape | (shape, dtype) |
+        ShapeDtypeStruct | example array}`` (default: the bound
+        ``data/label_shapes`` at float32), or a LIST of such dicts to
+        pre-warm several bucket shapes.  ``programs`` from
+        ``train`` / ``train_acc`` (fit's fused-metric variant) /
+        ``eval``.  With ``background=True`` compilation runs on a
+        daemon thread (overlapping the first epoch's data loading) and
+        the started Thread is returned; avals are snapshotted HERE, on
+        the calling thread, so later donating steps can't race the
+        lowering.  Otherwise returns a list of per-program info dicts
+        (``kind``/``source``/``seconds``).
+
+        The last program compiled per kind is installed for dispatch:
+        :meth:`step`/:meth:`forward` run it directly (the jit dispatch
+        cache is NOT populated by AOT compilation), falling back to the
+        jit path on batch-signature mismatch.
+        """
+        if not self._bound:
+            raise MXNetError("call bind() before compile()")
+        from .. import compile_cache as cc
+        sds = jax.ShapeDtypeStruct
+        specs = batch_spec if batch_spec is not None else self._input_shapes
+        if isinstance(specs, dict):
+            specs = [specs]
+
+        # aval snapshots taken on THIS thread: shape/dtype/sharding only,
+        # no live buffers, so background lowering never touches arrays a
+        # concurrent step may donate
+        p_avals = {n: sds(v.shape, v.dtype, sharding=v.sharding)
+                   for n, v in self._params.items()}
+        a_avals = {n: sds(v.shape, v.dtype, sharding=v.sharding)
+                   for n, v in self._aux.items()}
+        o_avals = {n: jax.tree.map(
+            lambda l: sds(l.shape, l.dtype, sharding=l.sharding),
+            self._opt_state[n]) for n in self._param_names}
+        bkey = self._base_key
+        k_aval = sds(bkey.shape, bkey.dtype,
+                     sharding=getattr(bkey, "sharding", None))
+        bsh = (batch_sharding(self.mesh, self.data_axis)
+               if self.data_axis is not None else replicated(self.mesh))
+
+        def norm_spec(spec):
+            out = {}
+            for n in self._input_names:
+                if n not in spec:
+                    raise MXNetError(f"batch_spec missing input {n!r}")
+                v = spec[n]
+                if isinstance(v, jax.ShapeDtypeStruct):
+                    shape, dtype = tuple(v.shape), v.dtype
+                elif isinstance(v, tuple) and len(v) == 2 \
+                        and isinstance(v[0], (tuple, list)):
+                    shape, dtype = tuple(v[0]), jnp.dtype(v[1])
+                elif hasattr(v, "shape") and hasattr(v, "dtype"):
+                    shape, dtype = tuple(v.shape), jnp.dtype(v.dtype)
+                else:
+                    shape, dtype = tuple(v), jnp.float32
+                out[n] = sds(shape, dtype, sharding=bsh)
+            return out
+
+        work = []
+        for spec in specs:
+            b_avals = norm_spec(spec)
+            for kind in programs:
+                work.append((kind, b_avals))
+
+        def compile_one(kind, b_avals):
+            # lr/t are concrete python scalars: lowering abstracts them to
+            # the same weak-typed avals the real dispatch produces, so the
+            # compiled program accepts any python float/int
+            if kind == "train":
+                jit_fn = self._train_step
+                in_args = (p_avals, a_avals, o_avals, b_avals, 0.5, 1,
+                           k_aval)
+            elif kind == "train_acc":
+                carry = sds((), jnp.int32, sharding=replicated(self.mesh))
+                jit_fn = self._train_step_acc
+                in_args = (p_avals, a_avals, o_avals, b_avals, 0.5, 1,
+                           carry, k_aval)
+            elif kind == "eval":
+                jit_fn = self._eval_step
+                in_args = (p_avals, a_avals, b_avals, 1, k_aval)
+            else:
+                raise MXNetError(f"unknown program kind {kind!r} "
+                                 "(train/train_acc/eval)")
+            key = self._program_key(kind, in_args)
+
+            def build():
+                with default_mesh(self.mesh), self._precision_scope():
+                    return jit_fn.lower(*in_args).compile()
+
+            compiled, info = cc.get_cache().get_or_compile(
+                key, build, label=f"trainer.{kind}")
+            self._aot[kind] = compiled
+            info = dict(info)
+            info["kind"] = kind
+            self.compile_info.append(info)
+            return info
+
+        if background:
+            import threading
+
+            def run():
+                for kind, b_avals in work:
+                    try:
+                        compile_one(kind, b_avals)
+                    except Exception:
+                        self.logger.exception(
+                            "background AOT compile of %r failed", kind)
+            th = threading.Thread(target=run, daemon=True,
+                                  name="mxnet-tpu-aot-compile")
+            th.start()
+            return th
+        return [compile_one(kind, b_avals) for kind, b_avals in work]
 
     # ------------------------------------------------------------------
     # Stepping
@@ -758,8 +936,11 @@ class ShardedTrainer:
             raise MXNetError("call bind() before step()")
         self._num_update += 1
         opt = self.optimizer
-        lr = (opt.lr_scheduler(self._num_update) if opt.lr_scheduler
-              else opt.lr)
+        # schedulers may hand back np.float64 — keep the dispatch scalar a
+        # python float so every step (and the AOT-lowered signature) sees
+        # the same weak-typed aval
+        lr = float(opt.lr_scheduler(self._num_update) if opt.lr_scheduler
+                   else opt.lr)
         placed = dict(self._place_batch(batch))
         self._guard_train_signature(placed)
         self.dispatch_count += 1
@@ -769,10 +950,36 @@ class ShardedTrainer:
         # scope the mesh so mesh-aware ops (RingAttention) pick up the seq
         # axis when this step traces
         with default_mesh(self.mesh), self._precision_scope():
+            fn = self._aot_or_jit("train", self._train_step)
             self._params, self._aux, self._opt_state, heads = \
-                self._train_step(self._params, self._aux, self._opt_state,
-                                 placed, lr, self._num_update)
+                fn(self._params, self._aux, self._opt_state,
+                   placed, lr, self._num_update, self._base_key)
         return list(heads)
+
+    def _aot_or_jit(self, kind: str, jit_fn):
+        """Dispatch wrapper preferring the AOT-compiled program for
+        ``kind`` when one exists.  An aval mismatch (different batch
+        shape/dtype than the program was lowered for) raises BEFORE the
+        executable consumes donated buffers, so falling back to the jit
+        path is safe; the stale AOT entry is dropped so the cost is paid
+        once."""
+        compiled = self._aot.get(kind)
+        if compiled is None:
+            return jit_fn
+
+        def dispatch(*args):
+            try:
+                out = compiled(*args)
+            except (TypeError, ValueError) as e:
+                self._aot.pop(kind, None)
+                self.aot_stats["fallbacks"] += 1
+                self.logger.warning(
+                    "AOT program %r does not match this call (%s); "
+                    "falling back to jit", kind, e)
+                return jit_fn(*args)
+            self.aot_stats["hits"] += 1
+            return out
+        return dispatch
 
     def place_batch(self, batch) -> Dict[str, jax.Array]:
         """Asynchronously stage a batch onto the mesh (prefetch hook)."""
@@ -783,8 +990,8 @@ class ShardedTrainer:
         count into ``carry`` — fit()'s zero-extra-dispatch metric path."""
         self._num_update += 1
         opt = self.optimizer
-        lr = (opt.lr_scheduler(self._num_update) if opt.lr_scheduler
-              else opt.lr)
+        lr = float(opt.lr_scheduler(self._num_update) if opt.lr_scheduler
+                   else opt.lr)
         placed = dict(self._place_batch(batch))
         self._guard_train_signature(placed)
         self.dispatch_count += 1
@@ -792,10 +999,10 @@ class ShardedTrainer:
             f"ShardedTrainer.step #{self._num_update} "
             "(donate_argnums: params, aux, opt_state)")
         with default_mesh(self.mesh), self._precision_scope():
+            fn = self._aot_or_jit("train_acc", self._train_step_acc)
             self._params, self._aux, self._opt_state, heads, carry = \
-                self._train_step_acc(self._params, self._aux,
-                                     self._opt_state, placed, lr,
-                                     self._num_update, carry)
+                fn(self._params, self._aux, self._opt_state, placed, lr,
+                   self._num_update, carry, self._base_key)
         return list(heads), carry
 
     def forward(self, batch) -> List[jax.Array]:
@@ -804,8 +1011,9 @@ class ShardedTrainer:
         self.dispatch_count += 1
         placed = dict(self._place_batch(batch))
         with default_mesh(self.mesh), self._precision_scope():
-            return list(self._eval_step(self._params, self._aux, placed,
-                                        self._eval_count))
+            fn = self._aot_or_jit("eval", self._eval_step)
+            return list(fn(self._params, self._aux, placed,
+                           self._eval_count, self._base_key))
 
     # ------------------------------------------------------------------
     # Param access / training loop
@@ -908,9 +1116,10 @@ class ShardedTrainer:
                                                               leaves)
         self._num_update = int(meta.get("num_update", step))
         if "rng_key" in meta:
-            self._base_key = _key_from_meta(meta["rng_key"])
-            # recompile: the step programs close over the base key
-            self._compile()
+            # the base key is a program ARGUMENT (pinned placement via
+            # _set_base_key), so swapping it here reuses the already-
+            # compiled step programs — zero new traces after resume
+            self._set_base_key(_key_from_meta(meta["rng_key"]))
         self.logger.info("restore_state: resumed at update %d from %s",
                          self._num_update, manager.step_path(step))
         return meta, step

@@ -32,6 +32,7 @@ overhead a step actually pays.  Exposed via ``bench.py --profile-step``.
 from __future__ import annotations
 
 import contextlib
+import threading
 import time
 from typing import Callable, Dict, List, Optional
 
@@ -42,7 +43,8 @@ import jax
 from .base import MXNetError
 
 __all__ = ["start", "stop", "trace", "annotate", "profile_step",
-           "format_step_profile"]
+           "format_step_profile", "record_compile", "compile_events",
+           "reset_compile_events", "format_compile_report"]
 
 _active_dir: Optional[str] = None
 
@@ -84,6 +86,63 @@ def annotate(name: str):
     """Label a region so it shows up in the trace timeline
     (``jax.profiler.TraceAnnotation``)."""
     return jax.profiler.TraceAnnotation(name)
+
+
+# ---------------------------------------------------------------------------
+# Compile telemetry
+# ---------------------------------------------------------------------------
+#
+# Every program resolution in the compile-cache subsystem (memory hit,
+# disk attach, fresh XLA compile) lands here as one event, so a run can
+# answer "where did my cold-start seconds go" without a trace viewer.
+
+_compile_events: List[Dict[str, object]] = []
+_compile_lock = threading.Lock()
+
+
+def record_compile(label: str, seconds: float, source: str = "compile",
+                   digest: str = "") -> None:
+    """Record one program resolution.  ``source`` is where the program
+    came from: ``compile`` (fresh XLA build), ``disk`` (persistent-cache
+    attach) or ``memory`` (in-process LRU hit)."""
+    with _compile_lock:
+        _compile_events.append({"label": str(label),
+                                "seconds": float(seconds),
+                                "source": str(source),
+                                "digest": str(digest)})
+
+
+def compile_events() -> List[Dict[str, object]]:
+    """Snapshot of recorded compile events (oldest first)."""
+    with _compile_lock:
+        return [dict(e) for e in _compile_events]
+
+
+def reset_compile_events() -> None:
+    with _compile_lock:
+        _compile_events.clear()
+
+
+def format_compile_report(title: str = "compile") -> str:
+    """Render the compile-event log: per-program line plus hit/miss and
+    total-seconds-by-source footer."""
+    events = compile_events()
+    lines = [f"compile report [{title}]  ({len(events)} programs)"]
+    if not events:
+        return lines[0]
+    width = max(len(str(e["label"])) for e in events)
+    totals: Dict[str, float] = {}
+    counts: Dict[str, int] = {}
+    for e in events:
+        src = str(e["source"])
+        totals[src] = totals.get(src, 0.0) + float(e["seconds"])
+        counts[src] = counts.get(src, 0) + 1
+        lines.append(f"  {str(e['label']).ljust(width)}  {src:<7}  "
+                     f"{float(e['seconds']):8.3f}s")
+    foot = "  ".join(f"{s}={counts[s]} ({totals[s]:.3f}s)"
+                     for s in sorted(counts))
+    lines.append(f"  -- {foot}")
+    return "\n".join(lines)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,611 @@
+"""Cold-start elimination (docs/perf.md r7): persistent program cache,
+AOT warmup, bucket-shape canonicalization.
+
+The contract under test: (a) cache keys are exactly as sensitive as XLA
+programs are (mesh/dtype/donation/sharding changes MISS, an identical
+re-lowering HITs); (b) ``Trainer.compile`` produces programs whose
+step outputs are BITWISE identical to the lazily-traced path; (c) a
+checkpoint restore re-attaches to the cached step program with zero new
+traces; (d) the bucket ladder collapses many lengths into few programs
+while padded batches keep the masked loss bitwise identical to the
+unpadded baseline.  All on the virtual 8-device CPU mesh from conftest.
+"""
+import json
+import logging
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import compile_cache as cc
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.parallel import ShardedTrainer, make_mesh
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _isolate_global_cache_and_rng():
+    # tests configure the global ProgramCache (sometimes with a disk
+    # dir); restore the env-default memory-only cache afterwards, and
+    # preserve the framework RNG stream for later test files
+    from mxnet_tpu import random as _mxrand
+    saved = _mxrand._state.get("key")
+    yield
+    cc.configure(cache_dir=None)
+    _mxrand._state["key"] = saved
+
+
+def _mlp():
+    data = mx.symbol.Variable("data")
+    net = mx.symbol.FullyConnected(data=data, num_hidden=32, name="fc1")
+    net = mx.symbol.Activation(data=net, act_type="relu")
+    net = mx.symbol.FullyConnected(data=net, num_hidden=10, name="fc2")
+    return mx.symbol.SoftmaxOutput(data=net, name="softmax")
+
+
+def _fc_trainer(seed=7, ndev=None, **kw):
+    devs = jax.devices() if ndev is None else jax.devices()[:ndev]
+    mx.random.seed(seed)
+    tr = ShardedTrainer(_mlp(), mesh=make_mesh({"data": len(devs)}, devs),
+                        optimizer="sgd",
+                        optimizer_params={"learning_rate": 0.1,
+                                          "momentum": 0.9}, **kw)
+    tr.bind(data_shapes={"data": (16, 8)},
+            label_shapes={"softmax_label": (16,)})
+    return tr
+
+
+def _batches(n, seed=0):
+    rng = np.random.RandomState(seed)
+    return [{"data": rng.randn(16, 8).astype(np.float32),
+             "softmax_label": rng.randint(0, 10, (16,)).astype(np.float32)}
+            for _ in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# Cache keys: exactly as sensitive as the compiled program
+# ---------------------------------------------------------------------------
+
+
+def _sds(shape, dtype=jnp.float32, sharding=None):
+    return jax.ShapeDtypeStruct(shape, dtype, sharding=sharding)
+
+
+def test_program_key_stable_across_relowering():
+    a = cc.program_key("fp", [_sds((4, 8))], donate=(0,), extra={"lr": 0.1})
+    b = cc.program_key("fp", [_sds((4, 8))], donate=(0,), extra={"lr": 0.1})
+    assert a == b and a.digest == b.digest
+    assert hash(a) == hash(b)
+
+
+@pytest.mark.parametrize("mutate", [
+    lambda: cc.program_key("OTHER", [_sds((4, 8))], donate=(0,)),
+    lambda: cc.program_key("fp", [_sds((4, 16))], donate=(0,)),
+    lambda: cc.program_key("fp", [_sds((4, 8), jnp.bfloat16)], donate=(0,)),
+    lambda: cc.program_key("fp", [_sds((4, 8))], donate=()),
+    lambda: cc.program_key("fp", [_sds((4, 8))], donate=(0,),
+                           extra={"lr": 0.2}),
+], ids=["fingerprint", "shape", "dtype", "donation", "hyper"])
+def test_program_key_sensitivity(mutate):
+    base = cc.program_key("fp", [_sds((4, 8))], donate=(0,))
+    assert mutate() != base
+
+
+def test_program_key_mesh_and_sharding_sensitivity():
+    devs = jax.devices()
+    m8 = make_mesh({"data": 8}, devs)
+    m4 = make_mesh({"data": 4}, devs[:4])
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    repl = NamedSharding(m8, P())
+    row = NamedSharding(m8, P("data"))
+    k_repl = cc.program_key("fp", [_sds((8, 8), sharding=repl)], mesh=m8)
+    k_row = cc.program_key("fp", [_sds((8, 8), sharding=row)], mesh=m8)
+    k_m4 = cc.program_key("fp", [_sds((8, 8), sharding=repl)], mesh=m4)
+    assert len({k_repl.digest, k_row.digest, k_m4.digest}) == 3
+    # the readable fields survive into describe() for the inspect tool
+    assert "PartitionSpec('data',)" in k_row.describe()["avals"]
+
+
+def test_graph_fingerprint_tracks_structure_not_names():
+    from mxnet_tpu.graph_eval import graph_fingerprint
+    a = _mlp()
+    b = _mlp()
+    assert graph_fingerprint(a) == graph_fingerprint(b)
+
+    def named(h):
+        data = mx.symbol.Variable("data")
+        net = mx.symbol.FullyConnected(data=data, num_hidden=h, name="x1")
+        net = mx.symbol.Activation(data=net, act_type="relu")
+        net = mx.symbol.FullyConnected(data=net, num_hidden=10, name="x2")
+        return mx.symbol.SoftmaxOutput(data=net, name="sm")
+
+    # same structure under different node names -> same fingerprint;
+    # a changed op parameter -> different
+    assert graph_fingerprint(named(32)) == graph_fingerprint(a)
+    assert graph_fingerprint(named(33)) != graph_fingerprint(a)
+
+
+# ---------------------------------------------------------------------------
+# ProgramCache: memory LRU + disk round trip
+# ---------------------------------------------------------------------------
+
+
+def _tiny_compiled():
+    f = jax.jit(lambda x: x * 2.0 + 1.0)
+    return f.lower(_sds((4,), jnp.float32)).compile()
+
+
+def test_cache_memory_disk_roundtrip(tmp_path):
+    cache = cc.ProgramCache(cache_dir=str(tmp_path), max_entries=4)
+    key = cc.program_key("roundtrip", [_sds((4,))])
+    calls = []
+
+    def build():
+        calls.append(1)
+        return _tiny_compiled()
+
+    c1, info1 = cache.get_or_compile(key, build, label="t")
+    assert info1["source"] == "compile" and len(calls) == 1
+    c2, info2 = cache.get_or_compile(key, build, label="t")
+    assert info2["source"] == "memory" and len(calls) == 1 and c2 is c1
+
+    cache.clear_memory()  # simulate a process restart
+    c3, info3 = cache.get_or_compile(key, build, label="t")
+    assert info3["source"] == "disk" and len(calls) == 1
+    x = jnp.arange(4, dtype=jnp.float32)
+    assert np.array_equal(np.asarray(c3(x)[0] if isinstance(c3(x), tuple)
+                                     else c3(x)),
+                          np.asarray(x * 2.0 + 1.0))
+    assert cache.stats["memory_hits"] == 1
+    assert cache.stats["disk_hits"] == 1
+    assert cache.stats["misses"] == 1
+
+    ents = cache.entries()
+    assert len(ents) == 1 and ents[0]["digest"] == key.digest
+    assert ents[0]["fields"]["fingerprint"] == "roundtrip"
+    assert cache.evict(key.digest[:8])
+    cache.clear_memory()
+    _, info4 = cache.get_or_compile(key, build, label="t")
+    assert info4["source"] == "compile" and len(calls) == 2
+
+
+def test_cache_lru_eviction_and_disabled():
+    cache = cc.ProgramCache(max_entries=2, enabled=True)
+    keys = [cc.program_key(f"lru{i}", [_sds((4,))]) for i in range(3)]
+    for k in keys:
+        cache.get_or_compile(k, _tiny_compiled)
+    assert cache.lookup(keys[0]) is None  # evicted (capacity 2)
+    assert cache.lookup(keys[2]) is not None
+
+    off = cc.ProgramCache(enabled=False)
+    off.put(keys[0], _tiny_compiled())
+    assert off.lookup(keys[0]) is None
+
+
+def test_get_cache_env_auto_configure(monkeypatch, tmp_path):
+    monkeypatch.setenv(cc.ENV_CACHE_DIR, str(tmp_path / "c"))
+    monkeypatch.setenv(cc.ENV_CACHE_MAX_ENTRIES, "7")
+    cc._global["cache"] = None
+    cache = cc.get_cache()
+    assert cache.cache_dir == str(tmp_path / "c")
+    assert cache.max_entries == 7
+    monkeypatch.setenv(cc.ENV_CACHE, "0")
+    cc._global["cache"] = None
+    assert not cc.get_cache().enabled
+
+
+# ---------------------------------------------------------------------------
+# Trainer AOT warmup: bitwise parity, dispatch reuse, background compile
+# ---------------------------------------------------------------------------
+
+
+def test_trainer_aot_bitwise_parity():
+    cc.configure(cache_dir=None)
+    batches = _batches(4)
+    lazy = _fc_trainer(seed=7)
+    ref = [np.asarray(lazy.step(b)[0]) for b in batches]
+
+    aot = _fc_trainer(seed=7)
+    infos = aot.compile(programs=("train",))
+    assert [i["kind"] for i in infos] == ["train"]
+    traced = aot.trace_counts["train"]  # the one lowering trace
+    assert traced <= 1
+    got = [np.asarray(aot.step(b)[0]) for b in batches]
+    for i, (r, g) in enumerate(zip(ref, got)):
+        assert np.array_equal(r, g), f"AOT step {i} diverged from jit path"
+    assert aot.aot_stats["hits"] == len(batches)
+    assert aot.aot_stats["fallbacks"] == 0
+    # the whole point: stepping never re-traced past the AOT lowering
+    assert aot.trace_counts["train"] == traced
+
+
+def test_trainer_aot_eval_and_batch_spec():
+    cc.configure(cache_dir=None)
+    tr = _fc_trainer(seed=3)
+    infos = tr.compile(batch_spec={"data": ((16, 8), np.float32),
+                                   "softmax_label": ((16,), np.float32)},
+                      programs=("train", "eval"))
+    assert {i["kind"] for i in infos} == {"train", "eval"}
+    traced = dict(tr.trace_counts)
+    b = _batches(1)[0]
+    tr.step(b)
+    tr.forward(b)
+    assert tr.aot_stats["hits"] == 2
+    assert tr.trace_counts == traced, "step/forward re-traced after AOT"
+
+
+def test_trainer_background_compile():
+    cc.configure(cache_dir=None)
+    tr = _fc_trainer(seed=5)
+    thread = tr.compile(programs=("train",), background=True)
+    thread.join(timeout=120)
+    assert not thread.is_alive()
+    traced = tr.trace_counts["train"]
+    tr.step(_batches(1)[0])
+    assert tr.aot_stats["hits"] == 1
+    assert tr.trace_counts["train"] == traced
+
+
+def test_second_trainer_reuses_program():
+    """Two identically-configured trainers resolve to ONE compiled
+    program (the in-process layer of the restart story)."""
+    cc.configure(cache_dir=None)
+    t1 = _fc_trainer(seed=7)
+    i1 = t1.compile(programs=("train",))
+    t2 = _fc_trainer(seed=9)
+    i2 = t2.compile(programs=("train",))
+    assert i1[0]["source"] == "compile"
+    assert i2[0]["source"] == "memory"
+    assert i1[0]["digest"] == i2[0]["digest"]
+    t2.step(_batches(1)[0])
+    assert t2.trace_counts["train"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Restore: zero new traces after resume
+# ---------------------------------------------------------------------------
+
+
+def test_restore_zero_new_traces(tmp_path):
+    from mxnet_tpu.checkpoint import CheckpointManager
+    cc.configure(cache_dir=None)
+    batches = _batches(6)
+    tr = _fc_trainer(seed=7)
+    tr.compile(programs=("train",))
+    for b in batches[:3]:
+        tr.step(b)
+    mgr = CheckpointManager(str(tmp_path))
+    tr.save_state(mgr)
+    ref = [np.asarray(tr.step(b)[0]) for b in batches[3:]]
+
+    tr2 = _fc_trainer(seed=999)
+    tr2.restore_state(mgr)
+    infos = tr2.compile(programs=("train",))
+    assert infos[0]["source"] == "memory", \
+        "restore re-compiled instead of re-attaching to the cached program"
+    for i, b in enumerate(batches[3:]):
+        got = np.asarray(tr2.step(b)[0])
+        assert np.array_equal(got, ref[i]), f"post-resume step {i} diverged"
+    assert tr2.trace_counts["train"] == 0, \
+        f"resume traced anew: {tr2.trace_counts}"
+    assert tr2.aot_stats["fallbacks"] == 0
+    mgr.close()
+
+
+# ---------------------------------------------------------------------------
+# Bucket policy / padding
+# ---------------------------------------------------------------------------
+
+
+def test_bucket_policy_ladder():
+    pol = cc.BucketPolicy(min_bucket=16, factor=2.0, round_to=16)
+    assert [pol.bucket_of(l) for l in (1, 16, 17, 32, 33, 100, 128)] == \
+        [16, 16, 32, 32, 64, 128, 128]
+    # round_to snaps ragged rungs up
+    pol = cc.BucketPolicy(min_bucket=10, factor=1.5, round_to=8)
+    rungs = {pol.bucket_of(l) for l in range(1, 130)}
+    assert all(r % 8 == 0 for r in rungs)
+    with pytest.raises(MXNetError):
+        cc.BucketPolicy(factor=1.0)
+    with pytest.raises(MXNetError):
+        pol.bucket_of(0)
+
+
+def test_plan_shape_buckets_caps_program_count():
+    lengths = [17, 23, 31, 40, 48, 57, 64, 77, 90, 101, 115, 128]
+    pol = cc.BucketPolicy(min_bucket=16, factor=2.0, round_to=16,
+                          max_buckets=8)
+    buckets = cc.plan_shape_buckets(lengths, pol)
+    assert buckets == [32, 64, 128]
+    assert len(buckets) <= 8
+    assert all(cc.bucket_for(l, buckets) >= l for l in lengths)
+    # a hostile length set still collapses: factor widens to fit
+    dense = list(range(10, 500, 7))
+    tight = cc.BucketPolicy(min_bucket=8, factor=1.05, round_to=1,
+                            max_buckets=4)
+    assert len(cc.plan_shape_buckets(dense, tight)) <= 4
+    with pytest.raises(MXNetError):
+        cc.bucket_for(200, [32, 64, 128])
+
+
+def test_bucket_policy_from_env(monkeypatch):
+    monkeypatch.setenv(cc.ENV_BUCKET_POLICY, "8:3.0:4")
+    monkeypatch.setenv(cc.ENV_MAX_BUCKETS, "5")
+    pol = cc.BucketPolicy.from_env()
+    assert (pol.min_bucket, pol.factor, pol.round_to, pol.max_buckets) == \
+        (8, 3.0, 4, 5)
+    monkeypatch.setenv(cc.ENV_BUCKET_POLICY, "junk")
+    with pytest.raises(MXNetError):
+        cc.BucketPolicy.from_env()
+
+
+def test_pad_to_bucket_and_batch(tmp_path):
+    arr = np.arange(12).reshape(2, 6)
+    padded = cc.pad_to_bucket(arr, 8, axis=1, pad_value=-1)
+    assert padded.shape == (2, 8)
+    assert np.array_equal(padded[:, :6], arr)
+    assert (padded[:, 6:] == -1).all()
+    with pytest.raises(MXNetError):
+        cc.pad_to_bucket(arr, 4, axis=1)
+    with pytest.raises(MXNetError):
+        cc.pad_to_bucket(arr, 8, axis=5)
+
+    from mxnet_tpu import nd
+    from mxnet_tpu.io import DataBatch, DataDesc, pad_batch_to_bucket
+    batch = DataBatch(
+        data=[nd.array(np.ones((2, 6)))],
+        label=[nd.array(np.full((2, 6), 3.0))],
+        provide_data=[DataDesc("data", (2, 6))],
+        provide_label=[DataDesc("softmax_label", (2, 6))],
+        bucket_key=6)
+    out = pad_batch_to_bucket(batch, 8, axis=1, pad_value=0, label_pad=-1)
+    assert out.bucket_key == 8
+    assert out.data[0].shape == (2, 8) and out.label[0].shape == (2, 8)
+    assert (out.data[0].asnumpy()[:, 6:] == 0).all()
+    assert (out.label[0].asnumpy()[:, 6:] == -1).all()
+    assert out.provide_data[0].shape == (2, 8)
+    assert out.provide_label[0].shape == (2, 8)
+
+
+# ---------------------------------------------------------------------------
+# Ragged lengths through a fixed attention block: exact no-op padding
+# ---------------------------------------------------------------------------
+
+
+def test_ragged_attention_matches_block_multiple_program():
+    """L=17 with an explicit 16-block pads internally to 32; its output
+    must equal the native L=32 program's first 17 positions BITWISE
+    (this is what makes bucket padding bitwise-neutral end to end)."""
+    from mxnet_tpu.ops.attention_ops import _attention_fwd
+    params = {"causal": True, "seq_axis": "seq", "layout": "blhd",
+              "block_size": 16}
+    rng = np.random.RandomState(0)
+    B, H, D = 2, 2, 8
+    q32, k32, v32 = (rng.randn(B, 32, H, D).astype(np.float32)
+                     for _ in range(3))
+    # zero tails: the padded-program view of the same 17-length inputs
+    for t in (q32, k32, v32):
+        t[:, 17:] = 0.0
+    f = jax.jit(lambda q, k, v: _attention_fwd(None, params, q, k, v))
+    out32 = np.asarray(f(q32, k32, v32))
+    out17 = np.asarray(f(q32[:, :17], k32[:, :17], v32[:, :17]))
+    assert out17.shape[1] == 17
+    assert np.array_equal(out32[:, :17], out17)
+
+
+# ---------------------------------------------------------------------------
+# BucketingModule: canonicalization, program reuse, runaway warning
+# ---------------------------------------------------------------------------
+
+
+def _lm_sym_gen(B, V=256, ignore=0):
+    from mxnet_tpu.models.transformer import transformer_lm
+
+    def sym_gen(key):
+        s = transformer_lm(vocab_size=V, num_layers=1, d_model=64, heads=4,
+                           batch_size=B, seq_len=int(key), loss_head=True,
+                           attn_block_size=16, ignore_label=ignore)
+        return s, ("data",), ("softmax_label",)
+    return sym_gen
+
+
+def _lm_batch(B, L, V=256, seed=0, bucket_key=None):
+    from mxnet_tpu import nd
+    from mxnet_tpu.io import DataBatch, DataDesc
+    rng = np.random.RandomState(seed)
+    data = rng.randint(1, V, (B, L)).astype(np.float64)
+    label = rng.randint(1, V, (B, L)).astype(np.float64)
+    return DataBatch(
+        data=[nd.array(data)], label=[nd.array(label)],
+        provide_data=[DataDesc("data", (B, L))],
+        provide_label=[DataDesc("softmax_label", (B, L))],
+        bucket_key=L if bucket_key is None else bucket_key), data, label
+
+
+def test_bucketing_canonicalization_bitwise():
+    """A ragged batch (L=17) routed through the 32-bucket yields the
+    masked loss of the unpadded 17-length program, bitwise.  Batch 8
+    keeps every matmul's row count in the same XLA:CPU gemm schedule
+    class as the bucket's (see docs/perf.md r7)."""
+    from mxnet_tpu.module import BucketingModule, Module
+    B = 8
+    pol = cc.BucketPolicy(min_bucket=16, factor=2.0, round_to=16,
+                          max_buckets=8, label_pad=0)
+    sym_gen = _lm_sym_gen(B)
+    bm = BucketingModule(sym_gen, default_bucket_key=32, bucket_policy=pol)
+    bm.bind(data_shapes=[("data", (B, 32))],
+            label_shapes=[("softmax_label", (B, 32))], for_training=False)
+    mx.random.seed(11)
+    bm.init_params()
+    arg_p, aux_p = bm.get_params()
+
+    batch, data, label = _lm_batch(B, 17)
+    bm.forward(batch, is_train=False)
+    out = bm.get_outputs()[0].asnumpy().reshape(B, 32)
+    assert (out[:, 17:] == 0.0).all(), "padded positions not masked"
+
+    base = Module(sym_gen(17)[0], data_names=("data",),
+                  label_names=("softmax_label",))
+    base.bind(data_shapes=[("data", (B, 17))],
+              label_shapes=[("softmax_label", (B, 17))], for_training=False)
+    base.set_params(arg_p, aux_p)
+    raw, _, _ = _lm_batch(B, 17)
+    base.forward(raw, is_train=False)
+    ref = base.get_outputs()[0].asnumpy().reshape(B, 17)
+    assert np.array_equal(out[:, :17], ref)
+
+    rep = bm.cache_report()
+    assert rep["buckets"] == 1  # 17 canonicalized INTO the default 32
+    assert rep["switch_hits"] == 1
+
+
+def test_bucketing_program_reuse_and_compile():
+    """12 distinct lengths -> 3 canonical programs; switch_bucket hits
+    report the reuse; BucketingModule.compile pre-binds the ladder."""
+    from mxnet_tpu.module import BucketingModule
+    B = 2
+    pol = cc.BucketPolicy(min_bucket=16, factor=2.0, round_to=16,
+                          max_buckets=8, label_pad=0)
+    sym_gen = _lm_sym_gen(B)
+    bm = BucketingModule(sym_gen, default_bucket_key=64, bucket_policy=pol)
+    bm.bind(data_shapes=[("data", (B, 64))],
+            label_shapes=[("softmax_label", (B, 64))], for_training=False)
+    mx.random.seed(12)
+    bm.init_params()
+    lengths = [17, 23, 31, 33, 40, 48, 57, 60, 62, 63, 64, 19]
+    for i, L in enumerate(lengths):
+        batch, _, _ = _lm_batch(B, L, seed=i)
+        bm.forward(batch, is_train=False)
+    rep = bm.cache_report()
+    assert rep["buckets"] == 2            # 32 and 64
+    assert rep["switches"] == len(lengths)
+    assert rep["switch_hits"] == len(lengths) - 1  # only 32 newly bound
+    assert rep["programs"] == 2           # one fwd program per bucket
+
+    # AOT warmup over the ladder: every bucket resolves through the
+    # global cache; a re-compile is all memory hits
+    infos = bm.compile(buckets=[32, 64])
+    assert {i["bucket"] for i in infos} == {32, 64}
+    infos2 = bm.compile(buckets=[32, 64])
+    assert all(i["source"] == "memory" for i in infos2)
+
+
+def test_bucketing_runaway_warning(caplog):
+    from mxnet_tpu.module import BucketingModule
+    B = 2
+    sym_gen = _lm_sym_gen(B)
+    bm = BucketingModule(sym_gen, default_bucket_key=64, max_buckets=2)
+    bm.bind(data_shapes=[("data", (B, 64))],
+            label_shapes=[("softmax_label", (B, 64))], for_training=False)
+    mx.random.seed(13)
+    bm.init_params()
+    with caplog.at_level(logging.WARNING):
+        for L in (16, 32, 48):
+            bm.switch_bucket(L, [("data", (B, L))],
+                             [("softmax_label", (B, L))])
+    assert any("distinct buckets" in r.message for r in caplog.records)
+    # warn once, not per switch
+    assert sum("distinct buckets" in r.message
+               for r in caplog.records) == 1
+
+
+# ---------------------------------------------------------------------------
+# Module / FeedForward warmup surfaces
+# ---------------------------------------------------------------------------
+
+
+def test_module_compile_warms_programs():
+    from mxnet_tpu.module import Module
+    cc.configure(cache_dir=None)
+    m = Module(_mlp(), data_names=("data",), label_names=("softmax_label",))
+    m.bind(data_shapes=[("data", (16, 8))],
+           label_shapes=[("softmax_label", (16,))], for_training=True)
+    mx.random.seed(2)
+    m.init_params()
+    infos = m.compile()
+    assert infos, "expected at least the forward program"
+    size_before = m._exec_group.program_cache_size()
+    m.forward(mx.io.DataBatch(
+        data=[mx.nd.array(np.random.rand(16, 8))],
+        label=[mx.nd.array(np.zeros(16))],
+        provide_data=[mx.io.DataDesc("data", (16, 8))],
+        provide_label=[mx.io.DataDesc("softmax_label", (16,))]),
+        is_train=True)
+    m.backward()
+    assert m._exec_group.program_cache_size() == size_before, \
+        "forward/backward after compile() created new programs"
+
+
+def test_feedforward_compile_requires_params():
+    from mxnet_tpu.model import FeedForward
+    ff = FeedForward(_mlp())
+    with pytest.raises(MXNetError):
+        ff.compile({"data": (4, 8)})
+
+
+# ---------------------------------------------------------------------------
+# Persistent round trip across processes (the real cold/warm story)
+# ---------------------------------------------------------------------------
+
+_CHILD = textwrap.dedent("""
+    import json, os, sys
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import compile_cache as cc
+    from mxnet_tpu.parallel import ShardedTrainer, make_mesh
+    import jax
+
+    mx.random.seed(7)
+    data = mx.symbol.Variable("data")
+    net = mx.symbol.FullyConnected(data=data, num_hidden=32, name="fc1")
+    net = mx.symbol.Activation(data=net, act_type="relu")
+    net = mx.symbol.FullyConnected(data=net, num_hidden=10, name="fc2")
+    sym = mx.symbol.SoftmaxOutput(data=net, name="softmax")
+    tr = ShardedTrainer(sym, mesh=make_mesh({"data": len(jax.devices())}),
+                        optimizer="sgd",
+                        optimizer_params={"learning_rate": 0.1})
+    tr.bind(data_shapes={"data": (16, 8)},
+            label_shapes={"softmax_label": (16,)})
+    infos = tr.compile(programs=("train",))
+    rng = np.random.RandomState(0)
+    head = tr.step({"data": rng.randn(16, 8).astype(np.float32),
+                    "softmax_label": rng.randint(0, 10, (16,))
+                    .astype(np.float32)})
+    print(json.dumps({"source": infos[0]["source"],
+                      "digest": infos[0]["digest"],
+                      "loss_finite": bool(np.isfinite(
+                          np.asarray(head[0])).all())}))
+""")
+
+
+def test_persistent_cache_across_processes(tmp_path):
+    """Cold process compiles and persists; a SECOND process attaches
+    from disk and steps — the preemption-restart acceptance path."""
+    env = dict(os.environ,
+               MXNET_TPU_CACHE_DIR=str(tmp_path),
+               JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8",
+               PYTHONPATH=REPO_ROOT)
+
+    def run():
+        out = subprocess.run([sys.executable, "-c", _CHILD], env=env,
+                             capture_output=True, text=True, timeout=240,
+                             cwd=REPO_ROOT)
+        assert out.returncode == 0, out.stderr[-2000:]
+        return json.loads(out.stdout.strip().splitlines()[-1])
+
+    cold = run()
+    warm = run()
+    assert cold["source"] == "compile"
+    assert warm["source"] == "disk", \
+        "second process did not attach from the persistent cache"
+    assert warm["digest"] == cold["digest"]
+    assert cold["loss_finite"] and warm["loss_finite"]

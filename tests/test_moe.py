@@ -133,7 +133,8 @@ def test_symbol_moe_lowers_to_explicit_all_to_all():
                              "lro_label": rng.rand(b, d).astype(np.float32)})
     with default_mesh(mesh):
         hlo = t._train_step.lower(t._params, t._aux, t._opt_state,
-                                  dict(placed), 0.1, 1).compile().as_text()
+                                  dict(placed), 0.1, 1,
+                                  t._base_key).compile().as_text()
     assert re.search(r"all-to-all", hlo), \
         "Symbol MoEFFN did not lower to the explicit all-to-all EP program"
 

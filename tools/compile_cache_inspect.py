@@ -1,0 +1,173 @@
+#!/usr/bin/env python
+"""Inspect / maintain the persistent program cache (docs/perf.md r7).
+
+Operates on the ``<cache_dir>/programs/`` metadata sidecars written by
+``mxnet_tpu.compile_cache.ProgramCache`` — no jax import, so it runs
+instantly on a login node:
+
+    compile_cache_inspect.py list                 # one line per program
+    compile_cache_inspect.py show <digest-prefix> # full key fields
+    compile_cache_inspect.py size                 # totals (count, bytes)
+    compile_cache_inspect.py evict <digest-prefix>
+    compile_cache_inspect.py clear
+
+The cache root comes from ``--cache-dir`` or ``MXNET_TPU_CACHE_DIR``.
+``list``/``size`` also count jax's own HLO-keyed cache under
+``<dir>/xla`` (opaque digests — listed only as a byte total).
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+ENV_CACHE_DIR = "MXNET_TPU_CACHE_DIR"
+
+
+def _progdir(root):
+    return os.path.join(root, "programs")
+
+
+def _entries(root):
+    d = _progdir(root)
+    if not os.path.isdir(d):
+        return []
+    out = []
+    for name in sorted(os.listdir(d)):
+        if not name.endswith(".json"):
+            continue
+        try:
+            with open(os.path.join(d, name)) as f:
+                out.append(json.load(f))
+        except (OSError, ValueError):
+            print(f"warning: unreadable sidecar {name}", file=sys.stderr)
+    return out
+
+
+def _bin_bytes(root, digest):
+    try:
+        return os.path.getsize(os.path.join(_progdir(root), f"{digest}.bin"))
+    except OSError:
+        return 0
+
+
+def _xla_bytes(root):
+    xla = os.path.join(root, "xla")
+    total = n = 0
+    for dirpath, _, files in os.walk(xla):
+        for f in files:
+            try:
+                total += os.path.getsize(os.path.join(dirpath, f))
+                n += 1
+            except OSError:
+                pass
+    return n, total
+
+
+def _fmt_bytes(b):
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if b < 1024 or unit == "GiB":
+            return f"{b:.1f} {unit}" if unit != "B" else f"{b} B"
+        b /= 1024
+
+
+def cmd_list(root, args):
+    ents = _entries(root)
+    if not ents:
+        print(f"no cached programs under {_progdir(root)}")
+        return 0
+    print(f"{'digest':14s} {'label':28s} {'size':>10s} "
+          f"{'compile_s':>9s} {'age':>8s} aval summary")
+    now = time.time()
+    for e in ents:
+        digest = e.get("digest", "?")
+        age_h = (now - e.get("created", now)) / 3600
+        # first leaf of the aval string is enough to recognize a program
+        avals = e.get("fields", {}).get("avals", "")
+        summary = avals.split(";")[0][:40] if avals else ""
+        print(f"{digest[:12]:14s} {e.get('label', '')[:28]:28s} "
+              f"{_fmt_bytes(_bin_bytes(root, digest)):>10s} "
+              f"{e.get('compile_seconds', 0):9.2f} {age_h:7.1f}h {summary}")
+    return 0
+
+
+def cmd_show(root, args):
+    ents = [e for e in _entries(root)
+            if e.get("digest", "").startswith(args.digest)]
+    if not ents:
+        print(f"no entry matching {args.digest!r}", file=sys.stderr)
+        return 1
+    for e in ents:
+        e = dict(e, payload_bytes=_bin_bytes(root, e.get("digest", "")))
+        print(json.dumps(e, indent=2, sort_keys=True))
+    return 0
+
+
+def cmd_size(root, args):
+    ents = _entries(root)
+    total = sum(_bin_bytes(root, e.get("digest", "")) for e in ents)
+    xn, xb = _xla_bytes(root)
+    print(f"programs: {len(ents)} entries, {_fmt_bytes(total)}")
+    print(f"xla:      {xn} files, {_fmt_bytes(xb)}")
+    print(f"total:    {_fmt_bytes(total + xb)}")
+    return 0
+
+
+def cmd_evict(root, args):
+    d = _progdir(root)
+    removed = []
+    if os.path.isdir(d):
+        for name in os.listdir(d):
+            if name.startswith(args.digest) and name.endswith((".bin", ".json")):
+                try:
+                    os.remove(os.path.join(d, name))
+                    removed.append(name)
+                except OSError as e:
+                    print(f"could not remove {name}: {e}", file=sys.stderr)
+    if not removed:
+        print(f"no entry matching {args.digest!r}", file=sys.stderr)
+        return 1
+    print(f"evicted {len(removed)} file(s): "
+          + ", ".join(sorted(removed)))
+    return 0
+
+
+def cmd_clear(root, args):
+    d = _progdir(root)
+    n = 0
+    if os.path.isdir(d):
+        for name in os.listdir(d):
+            if name.endswith((".bin", ".json")):
+                try:
+                    os.remove(os.path.join(d, name))
+                    n += 1
+                except OSError as e:
+                    print(f"could not remove {name}: {e}", file=sys.stderr)
+    print(f"removed {n} file(s) from {d}")
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--cache-dir", default=os.environ.get(ENV_CACHE_DIR),
+                    help=f"cache root (default: ${ENV_CACHE_DIR})")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    sub.add_parser("list", help="one line per cached program")
+    p = sub.add_parser("show", help="full key fields of matching entries")
+    p.add_argument("digest", help="digest prefix")
+    sub.add_parser("size", help="entry count and byte totals")
+    p = sub.add_parser("evict", help="remove entries by digest prefix")
+    p.add_argument("digest", help="digest prefix")
+    sub.add_parser("clear", help="remove every cached program")
+    args = ap.parse_args(argv)
+    if not args.cache_dir:
+        print(f"no cache dir: pass --cache-dir or set ${ENV_CACHE_DIR}",
+              file=sys.stderr)
+        return 2
+    return {"list": cmd_list, "show": cmd_show, "size": cmd_size,
+            "evict": cmd_evict, "clear": cmd_clear}[args.cmd](
+        args.cache_dir, args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
