@@ -59,6 +59,8 @@ from .monitor import Monitor
 from . import rtc
 from . import predictor
 from . import profiler
+from . import resilience
+from . import chaos
 from . import compile_cache
 from . import visualization
 from . import visualization as viz
@@ -74,5 +76,5 @@ __all__ = [
     "kvstore", "executor_manager", "model", "FeedForward", "lr_scheduler",
     "Initializer", "Uniform", "Normal", "Xavier", "Orthogonal", "Optimizer",
     "save_checkpoint", "load_checkpoint", "checkpoint", "CheckpointManager",
-    "compile_cache",
+    "compile_cache", "resilience", "chaos",
 ]

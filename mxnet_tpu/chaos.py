@@ -1,0 +1,214 @@
+# coding: utf-8
+"""Deterministic fault injection for exercising the resilience stack.
+
+Every defense in :mod:`mxnet_tpu.resilience` is tested against a *real*
+induced failure, not a mock: this module wraps a data iterator and, at
+exact global batch indices, replaces the batch with NaNs, with values
+large enough to overflow the backward pass, or raises from ``next()``
+to simulate a dying input pipeline.  Injection points are positional
+and deterministic so failures reproduce bit-for-bit across runs.
+
+Spec syntax (``MXNET_TPU_CHAOS`` or :meth:`ChaosSpec.parse`)::
+
+    kind:idx[,idx...][|kind:idx...]     e.g.  "nan:3|overflow:7,9|crash:5"
+
+Kinds: ``nan`` (NaN-filled data), ``overflow`` (1e30-filled data),
+``crash`` (raise :class:`ChaosError` from ``next()``).  Indices are
+*global* batch counts over the iterator's lifetime — they survive
+``reset()`` so an injection fires exactly once even across epochs.
+
+``flip_byte`` / ``corrupt_record`` corrupt RecordIO pack files on disk
+for the tolerant-reader tests.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import struct
+from typing import Any, Dict, Optional, Set
+
+import numpy as np
+
+_LOGGER = logging.getLogger(__name__)
+
+KINDS = ("nan", "overflow", "crash")
+
+OVERFLOW_VALUE = 1e30  # squares past f32 max, flushes f16/bf16 to inf
+
+
+class ChaosError(RuntimeError):
+    """The injected pipeline failure (distinguishable from real ones)."""
+
+
+class ChaosSpec(object):
+    def __init__(self, points: Dict[str, Set[int]]):
+        for kind in points:
+            if kind not in KINDS:
+                raise ValueError("unknown chaos kind %r (know %s)"
+                                 % (kind, ", ".join(KINDS)))
+        self.points = {k: set(v) for k, v in points.items() if v}
+
+    def __bool__(self) -> bool:
+        return bool(self.points)
+
+    def at(self, kind: str, index: int) -> bool:
+        return index in self.points.get(kind, ())
+
+    @classmethod
+    def parse(cls, spec: str) -> "ChaosSpec":
+        points: Dict[str, Set[int]] = {}
+        for part in spec.split("|"):
+            part = part.strip()
+            if not part:
+                continue
+            try:
+                kind, idxs = part.split(":", 1)
+            except ValueError:
+                raise ValueError("bad chaos spec %r (want kind:i,j|...)"
+                                 % spec)
+            points.setdefault(kind.strip(), set()).update(
+                int(i) for i in idxs.split(",") if i.strip())
+        return cls(points)
+
+
+def from_env() -> Optional[ChaosSpec]:
+    raw = os.environ.get("MXNET_TPU_CHAOS")
+    if not raw or not raw.strip():
+        return None
+    spec = ChaosSpec.parse(raw)
+    return spec if spec else None
+
+
+def _poison_array(arr, value: float):
+    """Same-shape/dtype replacement filled with ``value`` (NDArray or
+    numpy/jax array in, same flavor out)."""
+    data = getattr(arr, "data", arr)  # NDArray carries .data
+    filled = np.full(np.shape(data), value,
+                     dtype=np.asarray(data).dtype
+                     if not hasattr(data, "dtype") else data.dtype)
+    if hasattr(arr, "data"):
+        from .ndarray import array as nd_array
+        return nd_array(filled)
+    return filled
+
+
+class ChaosIter(object):
+    """Iterator wrapper injecting faults at fixed global batch indices.
+
+    Poisoning replaces every array in ``batch.data`` (``DataBatch``) or
+    every value of a dict batch; labels are left alone so metric code
+    stays exercised.  ``injected`` counts firings per kind."""
+
+    def __init__(self, data_iter, spec: ChaosSpec, logger=None):
+        self._iter = data_iter
+        self.spec = spec
+        self.logger = logger or _LOGGER
+        self._count = 0  # global batch index; NOT reset by reset()
+        self.injected = {k: 0 for k in KINDS}
+
+    # -- DataIter surface (delegate what we don't intercept) --
+    def __getattr__(self, name):
+        return getattr(self._iter, name)
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        self._iter.reset()
+
+    def _fire(self, kind: str, index: int):
+        self.injected[kind] += 1
+        self.logger.warning("chaos: injecting %s at global batch %d",
+                            kind, index)
+
+    def _poison_batch(self, batch, value: float):
+        if isinstance(batch, dict):
+            return {k: _poison_array(v, value) for k, v in batch.items()}
+        if hasattr(batch, "data"):  # DataBatch
+            import copy
+            out = copy.copy(batch)
+            out.data = [_poison_array(d, value) for d in batch.data]
+            return out
+        return _poison_array(batch, value)
+
+    def next(self):
+        i = self._count
+        self._count += 1
+        if self.spec.at("crash", i):
+            self._fire("crash", i)
+            raise ChaosError("chaos: injected pipeline crash at global "
+                             "batch %d" % i)
+        batch = self._iter.next()
+        if self.spec.at("nan", i):
+            self._fire("nan", i)
+            batch = self._poison_batch(batch, float("nan"))
+        elif self.spec.at("overflow", i):
+            self._fire("overflow", i)
+            batch = self._poison_batch(batch, OVERFLOW_VALUE)
+        return batch
+
+    def __next__(self):
+        try:
+            return self.next()
+        except StopIteration:
+            raise
+    __next__.__doc__ = next.__doc__
+
+
+def maybe_wrap(data_iter, logger=None):
+    """Wrap ``data_iter`` when ``MXNET_TPU_CHAOS`` is set; identity
+    otherwise (the production fast path imports nothing extra)."""
+    spec = from_env()
+    if spec is None or isinstance(data_iter, ChaosIter):
+        return data_iter
+    return ChaosIter(data_iter, spec, logger=logger)
+
+
+# --------------------------------------------------------------------
+# On-disk corruption helpers (RecordIO tolerant-reader tests)
+# --------------------------------------------------------------------
+
+def flip_byte(path: str, offset: int, mask: int = 0xFF) -> int:
+    """XOR the byte at ``offset`` with ``mask``; returns the old value."""
+    with open(path, "r+b") as f:
+        f.seek(offset)
+        old = f.read(1)
+        if len(old) != 1:
+            raise ValueError("offset %d past end of %s" % (offset, path))
+        f.seek(offset)
+        f.write(bytes([old[0] ^ (mask & 0xFF)]))
+    return old[0]
+
+
+def record_offsets(path: str):
+    """Byte offsets of every top-level record header in a RecordIO
+    pack file (walks the framing without decoding payloads)."""
+    from .recordio import _MAGIC, _LEN_MASK
+    offsets = []
+    size = os.path.getsize(path)
+    with open(path, "rb") as f:
+        pos = 0
+        in_multi = False
+        while pos + 8 <= size:
+            f.seek(pos)
+            header = f.read(8)
+            magic, lrec = struct.unpack("<II", header)
+            if magic != _MAGIC:
+                raise ValueError("%s: bad magic at %d (already corrupt?)"
+                                 % (path, pos))
+            cflag = lrec >> 29
+            length = lrec & _LEN_MASK
+            if not in_multi:
+                offsets.append(pos)
+            in_multi = cflag in (1, 2)
+            pos += 8 + length + ((-length) % 4)
+    return offsets
+
+
+def corrupt_record(path: str, record_index: int) -> int:
+    """Bit-flip the magic of the ``record_index``-th record so a reader
+    hits a framing error there; returns the corrupted byte offset."""
+    offsets = record_offsets(path)
+    off = offsets[record_index]
+    flip_byte(path, off, 0x01)
+    return off

@@ -25,6 +25,7 @@ state (optimizer state, RNG, step counter) goes through
 """
 from __future__ import annotations
 
+import contextlib
 import logging
 import os
 import signal
@@ -56,6 +57,7 @@ class CheckpointManager:
         self.verify_on_restore = verify_on_restore
         self.logger = logger or logging.getLogger(__name__)
         self.preempted = False
+        self._restoring = False
         self._writer = writer.AsyncCheckpointWriter(logger=self.logger)
         self._last_save_step: Optional[int] = None
         self._last_save_time: Optional[float] = None
@@ -188,6 +190,22 @@ class CheckpointManager:
                 verify=self.verify_on_restore)
         return out, manifest.get("meta", {}), step
 
+    @contextlib.contextmanager
+    def restoring(self):
+        """Mark a restore-in-progress window (divergence rollback).
+
+        While active, the preemption hook will NOT force a save: trainer
+        state mid-restore is a mix of old and new arrays, and persisting
+        it would corrupt the newest-checkpoint invariant the rollback is
+        trying to return to.  The signal still sets :attr:`preempted` and
+        drains the writer, so shutdown semantics are otherwise unchanged.
+        """
+        self._restoring = True
+        try:
+            yield self
+        finally:
+            self._restoring = False
+
     def restore_or_initialize(self, restore_fn: Callable[[int], Any],
                               init_fn: Optional[Callable[[], Any]] = None):
         """Auto-resume: newest committed checkpoint -> ``restore_fn(step)``;
@@ -219,13 +237,24 @@ class CheckpointManager:
             already = self.preempted
             self.preempted = True
             if not already:
-                self.logger.warning(
-                    "checkpoint: signal %d received — forcing a final "
-                    "save before shutdown", signum)
-                try:
-                    save_fn()
-                finally:
+                if self._restoring:
+                    # mid-rollback state is a mix of old and new arrays;
+                    # saving it would clobber the good checkpoint.  The
+                    # committed set on disk is already consistent.
+                    self.logger.warning(
+                        "checkpoint: signal %d received during a restore "
+                        "— skipping the forced save (committed "
+                        "checkpoints on disk remain the source of truth)",
+                        signum)
                     self.wait_until_finished()
+                else:
+                    self.logger.warning(
+                        "checkpoint: signal %d received — forcing a final "
+                        "save before shutdown", signum)
+                    try:
+                        save_fn()
+                    finally:
+                        self.wait_until_finished()
             prev = self._prev_handlers.get(signum)
             if callable(prev) and prev not in (signal.SIG_IGN,
                                                signal.SIG_DFL):

@@ -218,11 +218,22 @@ class ImageRecordIter(DataIter):
         os.replace(tmp, path)
         return mean
 
+    @property
+    def corrupt_records(self) -> int:
+        """Corrupt/truncated records skipped by the tolerant reader
+        (see :class:`mxnet_tpu.recordio.MXRecordIO` ``strict``)."""
+        return self._rec.corrupt_count
+
     # -- decode path ----------------------------------------------------
     def _decode_at(self, offset, aug, rng):
         with self._lock:
             self._rec._rec.seek(offset)
             raw = self._rec.read()
+        if raw is None:
+            # tolerant reader ran off EOF skipping corruption
+            raise MXNetError(
+                f"record at offset {offset} unreadable (file corrupt "
+                f"through EOF; {self._rec.corrupt_count} corrupt records)")
         header, img = rec_mod.unpack_img(raw)
         out = aug(img, rng)
         label = np.asarray(header.label, np.float32).reshape(-1)

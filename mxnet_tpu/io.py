@@ -422,17 +422,37 @@ class DevicePrefetchIter(DataIter):
     host batch is kept on :attr:`current_source` for callers that need
     ``batch.label``/``batch.pad``.  Exceptions raised by the inner iterator
     or ``place_fn`` propagate from :meth:`next` on the consumer thread.
+
+    Transient failures in the inner iterator or ``place_fn`` (flaky
+    storage, a briefly-wedged device transfer, an injected chaos crash)
+    are retried up to ``max_retries`` times with exponential backoff
+    before propagating; ``StopIteration`` is never retried.  Retries are
+    counted on ``retry_count`` and ``profiler.counter("io.prefetch_
+    retries")``.  :meth:`close` shuts the background thread down and
+    drops staged device buffers — call it (or let ``reset``/``__del__``)
+    when abandoning an epoch mid-way so no dangling thread pins device
+    memory.
     """
 
     _END = ("end", None, None)
 
-    def __init__(self, data_iter: DataIter, place_fn=None, depth: int = 2):
+    def __init__(self, data_iter: DataIter, place_fn=None, depth: int = 2,
+                 max_retries: Optional[int] = None,
+                 retry_backoff: float = 0.05, logger=None):
         super().__init__()
         if depth < 1:
             raise MXNetError("DevicePrefetchIter depth must be >= 1")
         self.data_iter = data_iter
         self.place_fn = place_fn if place_fn is not None else (lambda b: b)
         self.depth = depth
+        if max_retries is None:
+            max_retries = int(os.environ.get("MXNET_TPU_PREFETCH_RETRIES",
+                                             "2"))
+        self.max_retries = max(0, int(max_retries))
+        self.retry_backoff = float(retry_backoff)
+        import logging
+        self.logger = logger or logging.getLogger(__name__)
+        self.retry_count = 0
         self.batch_size = getattr(data_iter, "batch_size", 0)
         self.current_batch = None   # staged batch (place_fn output)
         self.current_source = None  # raw host batch from data_iter
@@ -462,15 +482,42 @@ class DevicePrefetchIter(DataIter):
                 except queue.Full:
                     continue
 
+        retries = self.max_retries
+        backoff = self.retry_backoff
+
+        def call_retrying(what, fn, *args):
+            # bounded retry with exponential backoff for TRANSIENT
+            # failures; StopIteration passes straight through (it is the
+            # protocol, not an error) and shutdown aborts the wait
+            failures = 0
+            while True:
+                try:
+                    return fn(*args)
+                except StopIteration:
+                    raise
+                except Exception as exc:
+                    failures += 1
+                    if failures > retries:
+                        raise
+                    self.retry_count += 1
+                    from . import profiler
+                    profiler.bump("io.prefetch_retries")
+                    self.logger.warning(
+                        "prefetch %s failed (%s: %s); retry %d/%d",
+                        what, type(exc).__name__, exc, failures, retries)
+                    if stop.wait(backoff * (2 ** (failures - 1))):
+                        raise
+
         def worker():
             try:
                 while not stop.is_set():
                     try:
-                        batch = inner.next()
+                        batch = call_retrying("iterator", inner.next)
                     except StopIteration:
                         put(DevicePrefetchIter._END)
                         return
-                    put(("batch", place(batch), batch))
+                    put(("batch", call_retrying("place_fn", place, batch),
+                         batch))
             except BaseException as exc:  # propagate to the consumer
                 put(("error", exc, None))
 
@@ -490,9 +537,20 @@ class DevicePrefetchIter(DataIter):
         except queue.Empty:
             pass
         self._thread.join(timeout=5.0)
+        if self._thread.is_alive():
+            self.logger.warning(
+                "DevicePrefetchIter worker did not exit within 5s")
         self._queue = None
         self._thread = None
         self._stop = None
+
+    def close(self) -> None:
+        """Stop the background thread and release staged batches (device
+        buffer references) — safe to call repeatedly; the iterator can be
+        restarted afterwards via ``reset``/``next``."""
+        self._shutdown()
+        self.current_batch = None
+        self.current_source = None
 
     def __del__(self):
         try:

@@ -11,6 +11,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from .. import kvstore as kvs_mod
 from .. import optimizer as opt_mod
+from .. import resilience
 from ..base import MXNetError
 from ..context import Context, cpu, current_context
 from ..initializer import Uniform
@@ -59,6 +60,7 @@ class Module(BaseModule):
         self._kvstore = None
         self._update_on_kvstore = None
         self._updater = None
+        self._grad_guard = None
         self._exec_group: Optional[DataParallelExecutorGroup] = None
         self._preload_opt_states = None
         self._preload_opt_blob = None
@@ -252,6 +254,13 @@ class Module(BaseModule):
             return
         (kvstore, update_on_kvstore) = _create_kvstore(
             kvstore, len(self._context), self._arg_params)
+        clip_gn = (dict(optimizer_params).get("clip_global_norm")
+                   if isinstance(optimizer, str)
+                   else getattr(optimizer, "clip_global_norm", None))
+        if update_on_kvstore and clip_gn is not None:
+            # clipping rescales grads host-side before the update; a
+            # kvstore-resident optimizer never sees the clipped grads
+            update_on_kvstore = False
         if isinstance(optimizer, str):
             batch_size = self._exec_group.batch_size
             if kvstore and "dist" in kvstore.type:
@@ -287,6 +296,10 @@ class Module(BaseModule):
             kvstore.set_optimizer(self._optimizer)
         else:
             self._updater = opt_mod.get_updater(optimizer)
+        # step-level guard (skip non-finite / clip global norm) from the
+        # optimizer's clip_global_norm / skip_nonfinite or MXNET_TPU_GUARD
+        self._grad_guard = resilience.legacy_guard_for(self._optimizer,
+                                                       logger=self.logger)
         self.optimizer_initialized = True
         if self._preload_opt_states is not None:
             self.load_optimizer_states(self._preload_opt_states)
@@ -302,6 +315,7 @@ class Module(BaseModule):
         self._kvstore = shared_module._kvstore
         self._update_on_kvstore = shared_module._update_on_kvstore
         self._updater = shared_module._updater
+        self._grad_guard = getattr(shared_module, "_grad_guard", None)
         self.optimizer_initialized = True
 
     def stage_batch(self, data_batch):
@@ -333,16 +347,17 @@ class Module(BaseModule):
         assert self.binded and self.params_initialized \
             and self.optimizer_initialized
         self._params_dirty = True
+        guard = getattr(self, "_grad_guard", None)
         if self._update_on_kvstore:
             _update_params_on_kvstore(self._exec_group.param_arrays,
                                       self._exec_group.grad_arrays,
-                                      self._kvstore)
+                                      self._kvstore, guard=guard)
         else:
             _update_params(self._exec_group.param_arrays,
                            self._exec_group.grad_arrays,
                            updater=self._updater,
                            num_device=len(self._context),
-                           kvstore=self._kvstore)
+                           kvstore=self._kvstore, guard=guard)
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded and self.params_initialized

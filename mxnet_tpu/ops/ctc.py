@@ -100,9 +100,10 @@ def _warpctc_fwd(ctx, params, data, label):
         return _fn(data, label), (data, label)
 
     def _b(res, g):
-        # CTC gradient wrt the pre-softmax activations; head cotangent is
-        # ignored exactly like the reference loss heads (warpctc-inl.h
-        # Backward writes the warp-ctc grads directly)
+        # CTC gradient wrt the pre-softmax activations, times the head
+        # cotangent (ones = the reference warpctc-inl.h Backward, which
+        # writes the warp-ctc grads directly; a scale-filled cotangent
+        # rides loss scaling through — resilience.py)
         data, label = res
         dt = jnp.promote_types(data.dtype, jnp.float32)
         logits = data.astype(dt).reshape(T, B, C)
@@ -111,6 +112,7 @@ def _warpctc_fwd(ctx, params, data, label):
         def total(lg):
             return jnp.sum(ctc_loss(lg, labels))
         grad = jax.grad(total)(logits).reshape(TB, C)
+        grad = grad * g.astype(grad.dtype)
         return grad.astype(data.dtype), jnp.zeros_like(label)
 
     _fn.defvjp(_f, _b)

@@ -942,12 +942,26 @@ def _softmax_output_core(data, label, grad_scale, ignore_label, multi_output,
         return _fn(data, label), (data, label)
 
     def _bwd(res, g):
-        # backward ignores the head gradient: grad = (prob - onehot(label))
-        # * grad_scale, optionally normalized by batch/valid count
-        # (softmax_output-inl.h Backward, SoftmaxOutputParam normalization)
+        # grad = (prob - onehot(label)) * grad_scale * head-cotangent,
+        # optionally normalized by batch/valid count
+        # (softmax_output-inl.h Backward, SoftmaxOutputParam
+        # normalization).  A ones cotangent multiplies by exactly 1.0 —
+        # bitwise the reference ignore-out_grad behavior — while a
+        # scale-filled one implements loss scaling (resilience.py)
+        cot = g
         data, label = res
         in_dtype = data.dtype
         data = _amp_f32(data)
+
+        def apply_cot(grad):
+            c = cot.astype(grad.dtype)
+            if c.ndim == grad.ndim:
+                return grad * c
+            # label-shaped cotangent (out_mode='loss'): broadcast over
+            # the class axis
+            if multi_output and grad.ndim > 2:
+                return grad * jnp.expand_dims(c, 1)
+            return grad * c[..., None]
 
         def norm_denom(mask):
             # count in f32: a bf16 accumulator cannot count past 256
@@ -970,6 +984,7 @@ def _softmax_output_core(data, label, grad_scale, ignore_label, multi_output,
             denom = norm_denom(mask)
             if denom is not None:
                 grad = grad / denom.astype(grad.dtype)
+            grad = apply_cot(grad)
         else:
             prob = jax.nn.softmax(data, axis=-1)
             oh = jax.nn.one_hot(label.astype(jnp.int32), data.shape[-1],
@@ -995,6 +1010,7 @@ def _softmax_output_core(data, label, grad_scale, ignore_label, multi_output,
                 scale = scale / denom.astype(data.dtype)
             if denom is not None or grad_scale != 1.0:
                 g = g * scale
+            g = apply_cot(g)
             grad = g.astype(in_dtype)
             if grad.dtype != jnp.float32:  # only when the cast narrows
                 grad = jax.lax.optimization_barrier(grad)
@@ -1052,7 +1068,8 @@ for _name in ("SoftmaxOutput", "Softmax"):  # "Softmax" is the deprecated alias
         params=dict(_SOFTMAX_OUT_PARAMS),
         infer_shape=_softmax_output_shape,
         is_loss=True,
-        doc="Softmax forward; backward = (prob - onehot(label)) ignoring head grad.",
+        doc="Softmax forward; backward = (prob - onehot(label)) times "
+            "the head cotangent (ones = reference behavior).",
     ))
 
 register_op(OpDef(
@@ -1088,6 +1105,12 @@ def _regression_head(transform, grad_fn):
             out = transform(data)
             n = max(1, int(np.prod(label.shape[1:])) if label.ndim > 1 else 1)
             grad = grad_fn(out, label.reshape(out.shape)) * (grad_scale / n)
+            # honor the head cotangent multiplicatively: a ones cotangent
+            # multiplies by exactly 1.0 (bitwise-neutral, the reference
+            # ignore-out_grad semantics), while a uniform scale-filled
+            # cotangent implements loss scaling and a per-element one a
+            # weighted loss
+            grad = grad * g.astype(grad.dtype)
             return grad, jnp.zeros_like(label)
 
         _fn.defvjp(_f, _b)
@@ -1154,7 +1177,10 @@ def _make_loss_fwd(ctx, params, x):
         return x, None
 
     def _b(res, g):
-        return (jnp.full_like(g, grad_scale),)
+        # grad_scale times the head cotangent: ones in (the reference
+        # semantics) gives grad_scale everywhere; a scale-filled
+        # cotangent rides loss scaling through (resilience.py)
+        return (g * jnp.asarray(grad_scale, g.dtype),)
 
     _fn.defvjp(_f, _b)
     return _fn(x)

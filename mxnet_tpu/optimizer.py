@@ -83,7 +83,8 @@ class Optimizer:
                  learning_rate: Optional[float] = None,
                  lr_scheduler: Optional[LRScheduler] = None,
                  sym=None, begin_num_update: int = 0,
-                 arg_names=None, **kwargs):
+                 arg_names=None, clip_global_norm: Optional[float] = None,
+                 skip_nonfinite: Optional[bool] = None, **kwargs):
         # None = "caller did not choose": callers that batch-rescale by
         # default (ShardedTrainer.bind) key off _rescale_set
         self._rescale_set = rescale_grad is not None
@@ -117,6 +118,13 @@ class Optimizer:
         self.num_update = begin_num_update
         self._index_update_count: Dict[int, int] = {}
         self.clip_gradient = clip_gradient
+        if clip_global_norm is not None and not clip_global_norm > 0:
+            raise MXNetError("clip_global_norm must be > 0, got "
+                             f"{clip_global_norm!r}")
+        # consumed by mxnet_tpu.resilience (ShardedTrainer fuses these
+        # into the compiled step; Module/FeedForward apply them host-side)
+        self.clip_global_norm = clip_global_norm
+        self.skip_nonfinite = skip_nonfinite
         if param_idx2name is None:
             param_idx2name = {}
         if not isinstance(param_idx2name, dict):

@@ -37,6 +37,7 @@ from ..base import MXNetError
 from ..graph_eval import eval_symbol
 from ..context import Context, cpu
 from .. import ndarray as nd_mod
+from .. import resilience
 from ..ndarray import NDArray, array as nd_array
 from .mesh import (DATA_AXIS, SEQ_AXIS, batch_sharding, data_parallel_mesh,
                    default_mesh, replicated)
@@ -141,6 +142,10 @@ class ShardedTrainer:
                  grad_accum: int = 1,
                  grad_compression: Optional[str] = None,
                  grad_bucket_bytes: Optional[int] = None,
+                 guard: Optional[bool] = None,
+                 clip_global_norm: Optional[float] = None,
+                 loss_scale=None,
+                 guard_params: Optional[Dict[str, Any]] = None,
                  logger=None):
         from .. import optimizer as opt_mod
         from ..initializer import Uniform
@@ -205,6 +210,30 @@ class ShardedTrainer:
         if grad_compression is not None and self.data_axis is None:
             raise MXNetError("grad_compression needs a data axis to "
                              "reduce over; this mesh has none")
+        # step-level anomaly defense (resilience.py): a fused non-finite
+        # guard gates the whole param/opt-state update with jnp.where (a
+        # bad step leaves state bitwise-unchanged), dynamic loss scaling
+        # rides the same stats for bf16/f16 compute, and global-norm
+        # clipping folds into the same single pass over the gradients.
+        # All in-graph, sync-free, donation-safe.  Off by default
+        # (guard=None reads MXNET_TPU_GUARD); clip_global_norm falls back
+        # to the optimizer's attribute so the legacy spelling works here.
+        if clip_global_norm is None:
+            clip_global_norm = getattr(self.optimizer, "clip_global_norm",
+                                       None)
+        self._resil = resilience.resolve(guard=guard,
+                                         clip_global_norm=clip_global_norm,
+                                         loss_scale=loss_scale,
+                                         **(guard_params or {}))
+        self._guard_state: Optional[Dict[str, jax.Array]] = None
+        # host-side sentinel state: LR backoff multiplier (applied to the
+        # traced lr argument at dispatch — changing it never retraces),
+        # rollback count, and the last drained counter snapshot
+        self._lr_scale = 1.0
+        self._rollbacks = 0
+        self._resil_drained: Dict[str, Any] = {}
+        self._sentinel = None
+        self._rollback_hook = None  # test/chaos hook: runs pre-rollback
         self._bound = False
         # steady-state instrumentation (same contract as pipeline_spmd):
         # dispatch_count counts compiled-program dispatches; trace_counts
@@ -367,6 +396,14 @@ class ShardedTrainer:
                 opt.state_zeros_like(template))
 
         self._params, self._aux, self._opt_state = params, aux, opt_state
+        if self._resil is not None:
+            # replicated scalars with PINNED placement (like the RNG base
+            # key): swapping values — dynamic scale updates, checkpoint
+            # restore, rollback — never changes the program signature
+            rep = replicated(self.mesh)
+            self._guard_state = {
+                k: self._global_put(v, rep)
+                for k, v in resilience.init_state(self._resil).items()}
         self._num_update = opt.begin_num_update
         self._lr_mult = {n: opt.lr_mult.get(n, 1.0) for n in self._param_names}
         self._wd_mult = {}
@@ -429,7 +466,7 @@ class ShardedTrainer:
                 total += int(np.prod(shard)) * leaf.dtype.itemsize
         return total
 
-    def _explicit_comm_grads(self, base):
+    def _explicit_comm_grads(self, base, resil: bool = False):
         """Wrap the grad computation in a manual shard_map region over the
         data axis: per-shard backward, then explicit bucketed (and
         optionally quantized) psums of the gradients — the comm path this
@@ -443,6 +480,13 @@ class ShardedTrainer:
         'batch'/'valid' normalization applies before the cross-shard
         sum), BatchNorm batch statistics are per-shard with pmean'd
         running aux, and dropout draws a distinct stream per shard.
+
+        With ``resil`` the wrapper threads the loss-scale scalar through
+        to ``base`` and piggybacks the guard's square-sum statistic on the
+        bucket traversal: each reduced flat bucket is already a contiguous
+        f32-castable buffer, so the finite/norm stat costs one fused
+        reduction per bucket and NO extra pass over the per-tensor grads.
+        The body then returns it as a fourth (replicated) output.
         """
         from .._compat import shard_map
         from .collectives import plan_buckets, psum_compressed
@@ -457,6 +501,7 @@ class ShardedTrainer:
             for n in order:
                 by_dtype.setdefault(jnp.dtype(grads[n].dtype), []).append(n)
             out = dict(grads)
+            sq = jnp.float32(0.0)
             for dtype, names in by_dtype.items():
                 names = [n for n in names
                          if int(np.prod(grads[n].shape, dtype=np.int64)) > 0]
@@ -471,6 +516,10 @@ class ShardedTrainer:
                             for pi, s0, s1 in bucket]
                     flat = segs[0] if len(segs) == 1 else jnp.concatenate(segs)
                     red = psum_compressed(flat, daxis, comp)
+                    if resil:
+                        # fused guard stat on the reduced flat bucket
+                        sq = sq + jnp.sum(jnp.square(
+                            red.astype(jnp.float32)))
                     off = 0
                     for pi, s0, s1 in bucket:
                         pieces[names[pi]].append(red[off:off + (s1 - s0)])
@@ -479,20 +528,32 @@ class ShardedTrainer:
                     ps = pieces[n]
                     flat = ps[0] if len(ps) == 1 else jnp.concatenate(ps)
                     out[n] = flat.reshape(grads[n].shape)
-            return out
+            return out, sq
 
-        def body(params, aux, batch, rng):
-            # distinct per-shard stream (dropout etc.); GSPMD gets the
-            # same effect from per-example positions in the global batch
-            rng = jax.random.fold_in(rng, jax.lax.axis_index(daxis))
-            grads, heads, auxu = base(params, aux, batch, rng)
-            grads = reduce_grads(grads)
-            auxu = {k: jax.lax.pmean(v, daxis) for k, v in auxu.items()}
-            return grads, heads, auxu
+        if resil:
+            def body(params, aux, batch, rng, scale):
+                rng = jax.random.fold_in(rng, jax.lax.axis_index(daxis))
+                grads, heads, auxu = base(params, aux, batch, rng, scale)
+                grads, sq = reduce_grads(grads)
+                auxu = {k: jax.lax.pmean(v, daxis) for k, v in auxu.items()}
+                return grads, heads, auxu, sq
 
-        kwargs = dict(mesh=self.mesh,
-                      in_specs=(P(), P(), P(self.data_axis), P()),
-                      out_specs=(P(), P(self.data_axis), P()))
+            kwargs = dict(mesh=self.mesh,
+                          in_specs=(P(), P(), P(self.data_axis), P(), P()),
+                          out_specs=(P(), P(self.data_axis), P(), P()))
+        else:
+            def body(params, aux, batch, rng):
+                # distinct per-shard stream (dropout etc.); GSPMD gets the
+                # same effect from per-example positions in the global batch
+                rng = jax.random.fold_in(rng, jax.lax.axis_index(daxis))
+                grads, heads, auxu = base(params, aux, batch, rng)
+                grads, _ = reduce_grads(grads)
+                auxu = {k: jax.lax.pmean(v, daxis) for k, v in auxu.items()}
+                return grads, heads, auxu
+
+            kwargs = dict(mesh=self.mesh,
+                          in_specs=(P(), P(), P(self.data_axis), P()),
+                          out_specs=(P(), P(self.data_axis), P()))
         try:
             return shard_map(body, check_vma=False, **kwargs)
         except TypeError:
@@ -540,8 +601,10 @@ class ShardedTrainer:
                     for n, v in p.items()}
 
         accum = self.grad_accum
+        resil = self._resil
+        scaling = bool(resil is not None and resil.scaling)
 
-        def _grads_and_heads(params, aux, batch, rng):
+        def _grads_and_heads(params, aux, batch, rng, *scale_arg):
             def fwd(p):
                 args = cast_params(p)
                 args.update(batch)
@@ -549,15 +612,31 @@ class ShardedTrainer:
                                           topo=topo)
                 return heads, auxu
             heads, vjp_fn, auxu = jax.vjp(fwd, params, has_aux=True)
-            ones = tuple(jnp.ones(h.shape, h.dtype) for h in heads)
+            if scaling:
+                # loss scaling = scaled head cotangents: the whole
+                # backward runs at `scale`x magnitude so bf16/f16
+                # gradients clear the subnormal floor; the unscale folds
+                # into the combined clip multiplier below (f32 master
+                # grads — no precision loss)
+                (scale,) = scale_arg
+                ones = tuple(jnp.broadcast_to(scale.astype(h.dtype),
+                                              h.shape) for h in heads)
+            else:
+                ones = tuple(jnp.ones(h.shape, h.dtype) for h in heads)
             (grads,) = vjp_fn(ones)
             return grads, heads, auxu
 
-        if self.grad_compression is not None and self.data_axis is not None:
-            _grads_and_heads = self._explicit_comm_grads(_grads_and_heads)
+        explicit = (self.grad_compression is not None
+                    and self.data_axis is not None)
+        if explicit:
+            _grads_and_heads = self._explicit_comm_grads(
+                _grads_and_heads, resil=resil is not None)
 
-        def train_step(params, aux, opt_state, batch, lr, t, base_key):
+        def train_step(params, aux, opt_state, batch, lr, t, base_key,
+                       gstate=None):
             rng = jax.random.fold_in(base_key, t)
+            scale_args = ((gstate["scale"],) if resil is not None else ())
+            sq = None
 
             if accum > 1:
                 # [B, ...] -> [k, B/k, ...]; grads sum across the scan,
@@ -580,8 +659,10 @@ class ShardedTrainer:
 
                 def micro(carry, xs):
                     aux_c, gsum, i = carry
-                    grads, heads, auxu = _grads_and_heads(
-                        params, aux_c, xs, jax.random.fold_in(accum_rng, i))
+                    res = _grads_and_heads(
+                        params, aux_c, xs, jax.random.fold_in(accum_rng, i),
+                        *scale_args)
+                    grads, heads, auxu = res[0], res[1], res[2]
                     aux_n = dict(aux_c)
                     aux_n.update(auxu)
                     return (aux_n, jax.tree.map(jnp.add, gsum, grads),
@@ -592,8 +673,41 @@ class ShardedTrainer:
                               for h in heads_k)
                 auxu = auxf
             else:
-                grads, heads, auxu = _grads_and_heads(params, aux, batch,
-                                                      rng)
+                res = _grads_and_heads(params, aux, batch, rng, *scale_args)
+                grads, heads, auxu = res[0], res[1], res[2]
+                if len(res) > 3:
+                    # explicit-comm path: guard stat came fused off the
+                    # reduced flat buckets (no extra pass over grads)
+                    sq = res[3]
+
+            ok = None
+            if resil is not None:
+                if sq is None:
+                    sq = resilience.tree_sq_sum(grads)
+                # overflow of the f32 square-sum reads as non-finite —
+                # exactly right: a gradient too large to measure is a step
+                # we must not take (and dynamic scaling backs off)
+                ok = jnp.isfinite(sq)
+                eff_norm = jnp.sqrt(sq) * jnp.float32(
+                    abs(self._rescale_grad) or 1.0)
+                mult = None
+                if scaling:
+                    inv_scale = jnp.float32(1.0) / gstate["scale"]
+                    eff_norm = eff_norm * inv_scale
+                    mult = inv_scale
+                if resil.clip_global_norm is not None:
+                    coef = jnp.minimum(
+                        jnp.float32(1.0),
+                        jnp.float32(resil.clip_global_norm)
+                        / jnp.maximum(eff_norm, jnp.float32(1e-12)))
+                    mult = coef if mult is None else mult * coef
+                if mult is not None:
+                    # ONE combined multiplier (unscale x clip) applied
+                    # once; with neither feature on, no multiply at all —
+                    # a guard-on clean run stays bitwise identical to
+                    # guard-off (pinned by tests/test_resilience.py)
+                    grads = {n: g * mult.astype(g.dtype)
+                             for n, g in grads.items()}
             new_params, new_opt = {}, {}
             for i, n in enumerate(param_names):
                 prng = jax.random.fold_in(rng, i) if needs_rng else None
@@ -621,9 +735,23 @@ class ShardedTrainer:
                                  t, prng)
                 if flat_len is not None:
                     w2 = w2[:int(np.prod(shape))].reshape(shape)
+                if resil is not None:
+                    # the non-finite gate: a bad step selects the OLD
+                    # param/opt buffers, so the update is a bitwise no-op
+                    # while staying donation-safe (same program, same
+                    # buffer flow) and requiring no host sync
+                    w2 = jnp.where(ok, w2, params[n])
+                    s2 = jax.tree_util.tree_map(
+                        lambda a, b: jnp.where(ok, a, b), s2, opt_state[n])
                 new_params[n] = w2
                 new_opt[n] = s2
             new_aux = dict(aux)
+            if resil is not None:
+                for k, v in auxu.items():
+                    new_aux[k] = jnp.where(ok, v, aux[k])
+                new_gstate = resilience.state_update(gstate, ok, eff_norm,
+                                                     resil)
+                return new_params, new_aux, new_opt, heads, new_gstate
             new_aux.update(auxu)
             return new_params, new_aux, new_opt, heads
 
@@ -669,9 +797,14 @@ class ShardedTrainer:
 
         self.trace_counts = {"train": 0, "train_acc": 0, "eval": 0}
         self._train_sigs = []
+        g_shard = ({k: replicated(self.mesh) for k in resilience.STATE_KEYS}
+                   if resil is not None else None)
+        train_out_sh = ((p_shard, a_shard, o_shard, None, g_shard)
+                        if resil is not None
+                        else (p_shard, a_shard, o_shard, None))
         self._train_step = jax.jit(
             _counted("train", train_step),
-            out_shardings=(p_shard, a_shard, o_shard, None),
+            out_shardings=train_out_sh,
             donate_argnums=(0, 1, 2))
         self._eval_step = jax.jit(_counted("eval", eval_step))
 
@@ -680,11 +813,7 @@ class ShardedTrainer:
         # syncs).  jit is lazy — this never compiles unless fit() uses it.
         label_names = list(self._label_names)
 
-        def train_step_acc(params, aux, opt_state, batch, lr, t, carry,
-                           base_key):
-            new_p, new_a, new_o, heads = train_step(params, aux, opt_state,
-                                                    batch, lr, t, base_key)
-            c = carry
+        def _fold_acc(heads, batch, c):
             for ln, head in zip(label_names, heads):
                 pred = head
                 if pred.ndim > 1:
@@ -695,11 +824,28 @@ class ShardedTrainer:
                 c = c + jnp.sum(pred.astype(jnp.int32).reshape(-1)
                                 == batch[ln].astype(jnp.int32).reshape(-1)
                                 ).astype(c.dtype)
-            return new_p, new_a, new_o, heads, c
+            return c
+
+        if resil is not None:
+            def train_step_acc(params, aux, opt_state, batch, lr, t, carry,
+                               base_key, gstate):
+                new_p, new_a, new_o, heads, gs = train_step(
+                    params, aux, opt_state, batch, lr, t, base_key, gstate)
+                return (new_p, new_a, new_o, heads,
+                        _fold_acc(heads, batch, carry), gs)
+            acc_out_sh = (p_shard, a_shard, o_shard, None, None, g_shard)
+        else:
+            def train_step_acc(params, aux, opt_state, batch, lr, t, carry,
+                               base_key):
+                new_p, new_a, new_o, heads = train_step(
+                    params, aux, opt_state, batch, lr, t, base_key)
+                return (new_p, new_a, new_o, heads,
+                        _fold_acc(heads, batch, carry))
+            acc_out_sh = (p_shard, a_shard, o_shard, None, None)
 
         self._train_step_acc = jax.jit(
             _counted("train_acc", train_step_acc),
-            out_shardings=(p_shard, a_shard, o_shard, None, None),
+            out_shardings=acc_out_sh,
             donate_argnums=(0, 1, 2))
         self._aot.clear()
 
@@ -733,6 +879,8 @@ class ShardedTrainer:
             "rules": sorted((n, str(self.rules.spec_for(n)))
                             for n in self._param_names),
             "x64": bool(jax.config.jax_enable_x64),
+            "resilience": (self._resil.describe()
+                           if self._resil is not None else None),
         }
         donate = () if kind == "eval" else (0, 1, 2)
         return cc.program_key(self._graph_fp, in_avals, donate=donate,
@@ -784,6 +932,10 @@ class ShardedTrainer:
         bkey = self._base_key
         k_aval = sds(bkey.shape, bkey.dtype,
                      sharding=getattr(bkey, "sharding", None))
+        g_avals = None
+        if self._guard_state is not None:
+            g_avals = {k: sds(v.shape, v.dtype, sharding=v.sharding)
+                       for k, v in self._guard_state.items()}
         bsh = (batch_sharding(self.mesh, self.data_axis)
                if self.data_axis is not None else replicated(self.mesh))
 
@@ -819,11 +971,15 @@ class ShardedTrainer:
                 jit_fn = self._train_step
                 in_args = (p_avals, a_avals, o_avals, b_avals, 0.5, 1,
                            k_aval)
+                if g_avals is not None:
+                    in_args += (g_avals,)
             elif kind == "train_acc":
                 carry = sds((), jnp.int32, sharding=replicated(self.mesh))
                 jit_fn = self._train_step_acc
                 in_args = (p_avals, a_avals, o_avals, b_avals, 0.5, 1,
                            carry, k_aval)
+                if g_avals is not None:
+                    in_args += (g_avals,)
             elif kind == "eval":
                 jit_fn = self._eval_step
                 in_args = (p_avals, a_avals, b_avals, 1, k_aval)
@@ -941,6 +1097,10 @@ class ShardedTrainer:
         # the same weak-typed aval
         lr = float(opt.lr_scheduler(self._num_update) if opt.lr_scheduler
                    else opt.lr)
+        if self._lr_scale != 1.0:
+            # sentinel backoff: lr is already a traced program argument,
+            # so scaling it host-side costs nothing and never retraces
+            lr *= self._lr_scale
         placed = dict(self._place_batch(batch))
         self._guard_train_signature(placed)
         self.dispatch_count += 1
@@ -951,9 +1111,15 @@ class ShardedTrainer:
         # axis when this step traces
         with default_mesh(self.mesh), self._precision_scope():
             fn = self._aot_or_jit("train", self._train_step)
-            self._params, self._aux, self._opt_state, heads = \
-                fn(self._params, self._aux, self._opt_state,
-                   placed, lr, self._num_update, self._base_key)
+            if self._resil is not None:
+                (self._params, self._aux, self._opt_state, heads,
+                 self._guard_state) = fn(
+                    self._params, self._aux, self._opt_state, placed, lr,
+                    self._num_update, self._base_key, self._guard_state)
+            else:
+                self._params, self._aux, self._opt_state, heads = \
+                    fn(self._params, self._aux, self._opt_state,
+                       placed, lr, self._num_update, self._base_key)
         return list(heads)
 
     def _aot_or_jit(self, kind: str, jit_fn):
@@ -992,6 +1158,8 @@ class ShardedTrainer:
         opt = self.optimizer
         lr = float(opt.lr_scheduler(self._num_update) if opt.lr_scheduler
                    else opt.lr)
+        if self._lr_scale != 1.0:
+            lr *= self._lr_scale
         placed = dict(self._place_batch(batch))
         self._guard_train_signature(placed)
         self.dispatch_count += 1
@@ -1000,9 +1168,16 @@ class ShardedTrainer:
             "(donate_argnums: params, aux, opt_state)")
         with default_mesh(self.mesh), self._precision_scope():
             fn = self._aot_or_jit("train_acc", self._train_step_acc)
-            self._params, self._aux, self._opt_state, heads, carry = \
-                fn(self._params, self._aux, self._opt_state, placed, lr,
-                   self._num_update, carry, self._base_key)
+            if self._resil is not None:
+                (self._params, self._aux, self._opt_state, heads, carry,
+                 self._guard_state) = fn(
+                    self._params, self._aux, self._opt_state, placed, lr,
+                    self._num_update, carry, self._base_key,
+                    self._guard_state)
+            else:
+                self._params, self._aux, self._opt_state, heads, carry = \
+                    fn(self._params, self._aux, self._opt_state, placed, lr,
+                       self._num_update, carry, self._base_key)
         return list(heads), carry
 
     def forward(self, batch) -> List[jax.Array]:
@@ -1061,6 +1236,16 @@ class ShardedTrainer:
                 "rng_key": _key_to_meta(self._base_key),
                 "data_axis_size": (self.mesh.shape[self.data_axis]
                                    if self.data_axis is not None else 1)}
+        if self._guard_state is not None:
+            # loss scale + guard counters travel with the checkpoint, so a
+            # resumed bf16 run continues at its working scale instead of
+            # re-walking the growth schedule from init_scale
+            vals = jax.device_get(self._guard_state)
+            meta["resilience"] = {
+                k: (float(np.asarray(v))
+                    if np.asarray(v).dtype.kind == "f"
+                    else int(np.asarray(v)))
+                for k, v in vals.items()}
         if extra_meta:
             meta.update(extra_meta)
         return meta
@@ -1120,6 +1305,16 @@ class ShardedTrainer:
             # _set_base_key), so swapping it here reuses the already-
             # compiled step programs — zero new traces after resume
             self._set_base_key(_key_from_meta(meta["rng_key"]))
+        if self._resil is not None and "resilience" in meta:
+            # same pinned replicated placement as bind() — the restored
+            # guard state slots into the compiled program without a trace
+            rep = replicated(self.mesh)
+            base = resilience.init_state(self._resil)
+            saved = meta["resilience"]
+            self._guard_state = {
+                k: self._global_put(
+                    np.asarray(saved.get(k, base[k]), base[k].dtype), rep)
+                for k in resilience.STATE_KEYS}
         self.logger.info("restore_state: resumed at update %d from %s",
                          self._num_update, manager.step_path(step))
         return meta, step
@@ -1131,6 +1326,84 @@ class ShardedTrainer:
         restarts."""
         return manager.restore_or_initialize(
             lambda step: self.restore_state(manager, step=step)[1])
+
+    # ------------------------------------------------------------------
+    # Resilience: counter drain + divergence sentinel
+    # ------------------------------------------------------------------
+
+    def resilience_stats(self) -> Dict[str, Any]:
+        """One-fetch snapshot of the guard counters (empty dict when the
+        guard is off).  Counters are cumulative since bind/restore; the
+        sentinel diffs successive snapshots, so reading them here never
+        resets anything on device."""
+        if self._guard_state is None:
+            return {}
+        vals = jax.device_get(self._guard_state)
+        return {
+            "skipped_steps": int(vals["skipped"]),
+            "overflow_steps": int(vals["overflows"]),
+            "good_steps": int(vals["good"]),
+            "loss_scale": float(vals["scale"]),
+            "norm_sum": float(vals["norm_sum"]),
+            "norm_steps": int(vals["norm_cnt"]),
+            "lr_scale": self._lr_scale,
+            "rollbacks": self._rollbacks,
+            "num_update": self._num_update,
+        }
+
+    def _sentinel_poll(self, manager=None) -> Optional[str]:
+        """Drain the guard counters and feed the divergence sentinel.
+
+        Called every ``GuardConfig.check_every`` batches from fit — the
+        only periodic device fetch the resilience tier makes.  On an
+        anomaly the learning rate is backed off host-side; on a sustained
+        streak the trainer rolls back to the manager's last good
+        checkpoint and resumes (the step program is cached, so the
+        rollback costs a restore, not a recompile)."""
+        stats = self.resilience_stats()
+        if not stats:
+            return None
+        last, self._resil_drained = self._resil_drained, stats
+        if not last:
+            return None  # first drain just baselines the counters
+        steps = stats["num_update"] - last["num_update"]
+        if steps <= 0:
+            return None
+        skipped = stats["skipped_steps"] - last["skipped_steps"]
+        cnt = stats["norm_steps"] - last["norm_steps"]
+        total = stats["norm_sum"] - last["norm_sum"]
+        norm_mean = (total / cnt) if cnt > 0 else None
+        if self._sentinel is None:
+            self._sentinel = resilience.DivergenceSentinel(
+                self._resil, logger=self.logger)
+        action = self._sentinel.observe(norm_mean, skipped, steps)
+        if action is None:
+            return None
+        from .. import profiler
+        self._lr_scale = max(self._lr_scale * self._resil.lr_backoff,
+                             self._resil.min_lr_scale)
+        if action == "rollback" and manager is not None \
+                and manager.latest_step() is not None:
+            if self._rollback_hook is not None:
+                self._rollback_hook()
+            restoring = getattr(manager, "restoring", None)
+            import contextlib
+            with (restoring() if restoring is not None
+                  else contextlib.nullcontext()):
+                _, step = self.restore_state(manager)
+            self._rollbacks += 1
+            profiler.bump("resilience.rollbacks")
+            # guard counters rolled back with the state: re-baseline
+            self._resil_drained = self.resilience_stats()
+            self.logger.warning(
+                "Resilience: rolled back to checkpoint at update %d, "
+                "lr-scale=%g (cached step program, no recompile)",
+                step, self._lr_scale)
+        else:
+            profiler.bump("resilience.backoffs")
+            self.logger.warning(
+                "Resilience: LR backed off, lr-scale=%g", self._lr_scale)
+        return action
 
     def _metric_proxy(self, eval_metric):
         return _AsyncMetric(eval_metric)
@@ -1211,6 +1484,11 @@ class ShardedTrainer:
         # get()/get_name_value() (e.g. from a Speedometer callback)
         # drain exactly then
         am = self._metric_proxy(eval_metric)
+        # chaos harness: when MXNET_TPU_CHAOS is set, deterministic fault
+        # injection wraps the iterator HERE — upstream of the prefetch
+        # thread, so injected crashes exercise the real retry path
+        from .. import chaos as chaos_mod
+        train_data = chaos_mod.maybe_wrap(train_data, logger=self.logger)
         # async double-buffered input placement: a background thread pulls
         # batch k+1 from the iterator and dispatches its sharded committed
         # device_put while step k's compute runs — the host never sits
@@ -1224,60 +1502,82 @@ class ShardedTrainer:
         # mesh-replicated step output is a cache miss)
         carry_sh = NamedSharding(self.mesh, P())
         am.carry_init = lambda: jax.device_put(jnp.int32(0), carry_sh)
-        for epoch in range(begin_epoch, num_epoch):
-            tic = time.time()
-            am.reset()
-            nbatch = 0
-            prefetch.reset()
-            fused = am.supports_fused and bool(self._label_names)
-            nheads = len(self.symbol.list_outputs())
-            ninst_names = self._label_names[:nheads]
-            for cur in prefetch:
-                if fused:
-                    # accuracy folds inside the step program: ONE dispatch
-                    # per batch, no extra host<->device traffic at all
-                    outs, carry = self._step_acc(cur, am.take_carry())
-                    am.put_carry(carry, sum(
-                        int(np.prod(cur[n].shape)) for n in ninst_names))
-                else:
-                    outs = self.step(cur)
-                    # labels already live on device in the placed batch —
-                    # no second host->device hop for the metric
-                    lbls = ([cur[n] for n in self._label_names]
-                            if self._label_names
-                            else prefetch.current_source.label)
-                    am.update_async(lbls, outs)
-                nbatch += 1
-                if batch_end_callback is not None:
-                    from ..model import BatchEndParam
-                    batch_end_callback(BatchEndParam(
-                        epoch=epoch, nbatch=nbatch, eval_metric=am,
-                        locals=locals()))
-                if checkpoint_manager is not None:
-                    self._fit_checkpoint(checkpoint_manager, am, epoch,
-                                         nbatch)
-                    if checkpoint_manager.preempted:
-                        self.logger.warning(
-                            "fit: preemption signal received — state saved "
-                            "at update %d, stopping (restore_or_initialize "
-                            "resumes on restart)", self._num_update)
-                        checkpoint_manager.wait_until_finished()
-                        return
-            name, value = am.get()
-            names = name if isinstance(name, list) else [name]
-            values = value if isinstance(value, list) else [value]
-            for n_, v_ in zip(names, values):
-                self.logger.info("Epoch[%d] Mesh-Train-%s=%f", epoch, n_, v_)
-            self.logger.info("Epoch[%d] Step-total=%d Elapsed=%.3fs",
-                             epoch, nbatch, time.time() - tic)
-            if epoch_end_callback is not None:
-                arg_p, aux_p = self.get_params()
-                epoch_end_callback(epoch, self.symbol, arg_p, aux_p)
-            if eval_data is not None:
-                m = self.score(eval_data, eval_metric)
-                for name, value in [m.get()]:
-                    self.logger.info("Epoch[%d] Mesh-Validation-%s=%s",
-                                     epoch, name, value)
+        check_every = (self._resil.check_every if self._resil is not None
+                       else 0)
+        try:
+            for epoch in range(begin_epoch, num_epoch):
+                tic = time.time()
+                am.reset()
+                nbatch = 0
+                prefetch.reset()
+                fused = am.supports_fused and bool(self._label_names)
+                nheads = len(self.symbol.list_outputs())
+                ninst_names = self._label_names[:nheads]
+                for cur in prefetch:
+                    if fused:
+                        # accuracy folds inside the step program: ONE
+                        # dispatch per batch, no extra host<->device
+                        # traffic at all
+                        outs, carry = self._step_acc(cur, am.take_carry())
+                        am.put_carry(carry, sum(
+                            int(np.prod(cur[n].shape))
+                            for n in ninst_names))
+                    else:
+                        outs = self.step(cur)
+                        # labels already live on device in the placed
+                        # batch — no second host->device hop for the
+                        # metric
+                        lbls = ([cur[n] for n in self._label_names]
+                                if self._label_names
+                                else prefetch.current_source.label)
+                        am.update_async(lbls, outs)
+                    nbatch += 1
+                    if batch_end_callback is not None:
+                        from ..model import BatchEndParam
+                        batch_end_callback(BatchEndParam(
+                            epoch=epoch, nbatch=nbatch, eval_metric=am,
+                            locals=locals()))
+                    if checkpoint_manager is not None:
+                        self._fit_checkpoint(checkpoint_manager, am, epoch,
+                                             nbatch)
+                        if checkpoint_manager.preempted:
+                            self.logger.warning(
+                                "fit: preemption signal received — state "
+                                "saved at update %d, stopping "
+                                "(restore_or_initialize resumes on "
+                                "restart)", self._num_update)
+                            checkpoint_manager.wait_until_finished()
+                            return
+                    if check_every and nbatch % check_every == 0:
+                        self._sentinel_poll(checkpoint_manager)
+                name, value = am.get()
+                names = name if isinstance(name, list) else [name]
+                values = value if isinstance(value, list) else [value]
+                for n_, v_ in zip(names, values):
+                    self.logger.info("Epoch[%d] Mesh-Train-%s=%f",
+                                     epoch, n_, v_)
+                self.logger.info("Epoch[%d] Step-total=%d Elapsed=%.3fs",
+                                 epoch, nbatch, time.time() - tic)
+                if self._resil is not None:
+                    rs = self.resilience_stats()
+                    # one line per epoch, grep-stable for tools/parse_log
+                    self.logger.info(
+                        "Epoch[%d] Resilience: skipped=%d overflows=%d "
+                        "rollbacks=%d loss-scale=%g lr-scale=%g",
+                        epoch, rs["skipped_steps"], rs["overflow_steps"],
+                        rs["rollbacks"], rs["loss_scale"], rs["lr_scale"])
+                if epoch_end_callback is not None:
+                    arg_p, aux_p = self.get_params()
+                    epoch_end_callback(epoch, self.symbol, arg_p, aux_p)
+                if eval_data is not None:
+                    m = self.score(eval_data, eval_metric)
+                    for name, value in [m.get()]:
+                        self.logger.info("Epoch[%d] Mesh-Validation-%s=%s",
+                                         epoch, name, value)
+        finally:
+            # an abandoned/preempted epoch must not leave the prefetch
+            # thread alive holding staged device buffers
+            prefetch.close()
 
 
 # ---------------------------------------------------------------------------

@@ -44,7 +44,8 @@ from .base import MXNetError
 
 __all__ = ["start", "stop", "trace", "annotate", "profile_step",
            "format_step_profile", "record_compile", "compile_events",
-           "reset_compile_events", "format_compile_report"]
+           "reset_compile_events", "format_compile_report",
+           "bump", "counter", "counters", "reset_counters"]
 
 _active_dir: Optional[str] = None
 
@@ -143,6 +144,43 @@ def format_compile_report(title: str = "compile") -> str:
                      for s in sorted(counts))
     lines.append(f"  -- {foot}")
     return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# Event counters
+# ---------------------------------------------------------------------------
+#
+# Process-wide named counters for rare-but-interesting events the
+# resilience tier produces (skipped steps, prefetch retries, corrupt
+# records, rollbacks).  Dotted names namespace the producer, e.g.
+# ``io.prefetch_retries``.  Cheap enough to bump from worker threads.
+
+_counters: Dict[str, int] = {}
+_counter_lock = threading.Lock()
+
+
+def bump(name: str, n: int = 1) -> None:
+    """Increment counter ``name`` by ``n`` (created at 0)."""
+    with _counter_lock:
+        _counters[name] = _counters.get(name, 0) + int(n)
+
+
+def counter(name: str) -> int:
+    with _counter_lock:
+        return _counters.get(name, 0)
+
+
+def counters(prefix: str = "") -> Dict[str, int]:
+    """Snapshot of counters, optionally filtered by dotted prefix."""
+    with _counter_lock:
+        return {k: v for k, v in _counters.items()
+                if k.startswith(prefix)}
+
+
+def reset_counters(prefix: str = "") -> None:
+    with _counter_lock:
+        for k in [k for k in _counters if k.startswith(prefix)]:
+            del _counters[k]
 
 
 # ---------------------------------------------------------------------------

@@ -246,11 +246,27 @@ class MXRecordIO:
         Path to the ``.rec`` file.
     flag : str
         ``"r"`` to read, ``"w"`` to write.
+    strict : bool, optional
+        Corrupt-record policy for reading.  The default (``False``, or
+        ``MXNET_TPU_RECORDIO_STRICT=1`` to flip it) SKIPS a corrupt or
+        truncated record: the reader logs one warning, bumps
+        :attr:`corrupt_count` (and ``profiler.counter("recordio.
+        corrupt_records")``), resynchronizes on the next valid record
+        header, and keeps going — one flipped bit no longer kills an
+        epoch.  ``strict=True`` restores the old raise-on-corruption
+        behavior for integrity checks.
     """
 
-    def __init__(self, uri, flag):
+    def __init__(self, uri, flag, strict=None):
         self.uri = uri
         self.flag = flag
+        if strict is None:
+            strict = os.environ.get("MXNET_TPU_RECORDIO_STRICT",
+                                    "0").strip() not in ("0", "", "false")
+        self.strict = bool(strict)
+        self.corrupt_count = 0
+        self._warned_corrupt = False
+        self._last_pos = None
         self.is_open = False
         self.open()
 
@@ -279,7 +295,73 @@ class MXRecordIO:
 
     def read(self):
         assert not self.writable
-        return self._rec.read()
+        try:
+            self._last_pos = self._rec.tell()
+        except Exception:
+            self._last_pos = None
+        try:
+            return self._rec.read()
+        except MXNetError as err:
+            if self.strict:
+                raise
+            return self._read_resync(err)
+
+    def _read_resync(self, err):
+        """Skip past a corrupt record: scan forward (4-byte aligned, the
+        framing's alignment) for the next header whose full record parses,
+        and continue from there on the pure-Python engine — the native
+        reader's internal position is unknowable after a failure.
+        Continuation frames of a torn multi-frame record self-reject (a
+        leading cflag 2/3 is a framing error), so resync always lands on
+        a true record boundary.  Returns the next good record, or None
+        when the corruption runs to EOF."""
+        self.corrupt_count += 1
+        try:
+            from . import profiler
+            profiler.bump("recordio.corrupt_records")
+        except Exception:
+            pass
+        if not self._warned_corrupt:
+            import logging
+            logging.getLogger(__name__).warning(
+                "corrupt record in %s (%s); skipping — further skips are "
+                "only counted on .corrupt_count (strict=True to raise)",
+                self.uri, err)
+            self._warned_corrupt = True
+        size = os.path.getsize(self.uri)
+        magic = struct.pack("<I", _MAGIC)
+        start = (self._last_pos if self._last_pos is not None else 0) + 1
+        pos = start + ((-start) % 4)
+        py = _PyRecordFile(self.uri, "r")
+        window = 1 << 16
+        with open(self.uri, "rb") as f:
+            while pos + 8 <= size:
+                f.seek(pos)
+                chunk = f.read(window)
+                i = chunk.find(magic)
+                while i != -1:
+                    cand = pos + i
+                    if cand % 4 == 0 and cand + 8 <= size:
+                        py.seek(cand)
+                        try:
+                            rec = py.read()
+                        except MXNetError:
+                            rec = False  # candidate did not parse
+                        if rec is not False:
+                            self._adopt_py_engine(py)
+                            return rec  # a record, or None at clean EOF
+                    i = chunk.find(magic, i + 1)
+                # overlap so a header straddling the window edge is seen
+                pos += window - 7
+        self._adopt_py_engine(py)  # positioned at/after EOF
+        return None
+
+    def _adopt_py_engine(self, py):
+        try:
+            self._rec.close()
+        except Exception:
+            pass
+        self._rec = py
 
     def tell(self):
         return self._rec.tell()

@@ -1,0 +1,436 @@
+# coding: utf-8
+"""Step-level anomaly defense: non-finite guard, dynamic loss scaling,
+global-norm clipping, and divergence rollback.
+
+The reference framework treated bad steps as communication-layer events
+(parameter-server retransmits); a TPU-native stack has to defend the
+*numerics* instead, and it has to do so in-graph: a host-side
+``if not np.isfinite(grad)`` check would force a device sync every step
+and destroy the donation-complete dispatch loop.  The pieces here:
+
+``GuardConfig``
+    Static configuration for the in-graph guard (resolved once, baked
+    into the compiled step program's key — changing it recompiles,
+    toggling it off leaves the program byte-identical to a build that
+    never knew about it).
+
+guard state (``init_state`` / ``state_update``)
+    Six replicated device scalars (loss scale, good-step streak, and
+    cumulative skipped / overflow / grad-norm counters) threaded through
+    the step program exactly like ``num_update``: passed as a pinned
+    program argument, returned updated, never synced inside the loop.
+    Counters are cumulative; hosts diff against their last drain, so
+    draining costs one small fetch and resetting costs nothing.
+
+``DivergenceSentinel``
+    Host-side rolling detector fed by periodic guard-state drains in
+    ``fit``: a gradient-norm spike against the rolling median, or a
+    window where every step was skipped, first backs off the learning
+    rate and past a streak threshold requests a rollback to the last
+    good checkpoint.
+
+``LegacyGuard``
+    The same skip/clip semantics for the legacy ``Module`` /
+    ``FeedForward`` update path (host-driven per-device updaters).  That
+    path syncs per step anyway, so the guard's single fused finite/norm
+    fetch adds one small scalar transfer, not a new sync point.
+
+See ``docs/resilience.md`` for semantics and the measured overhead.
+"""
+from __future__ import annotations
+
+import collections
+import logging
+import os
+import statistics
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_LOGGER = logging.getLogger(__name__)
+
+# Keys of the device-side guard state, in a fixed order so program
+# signatures and checkpoints are stable.
+STATE_KEYS = ("scale", "good", "skipped", "overflows", "norm_sum", "norm_cnt")
+
+_INT_KEYS = frozenset(("good", "skipped", "overflows", "norm_cnt"))
+
+
+def _env_flag(name: str, default: Optional[bool] = None) -> Optional[bool]:
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    return raw.strip().lower() not in ("0", "false", "off", "")
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        raise ValueError("%s must be a float, got %r" % (name, raw))
+
+
+class GuardConfig(object):
+    """Static guard configuration.
+
+    ``loss_scale`` is ``None`` (off), a fixed float, or ``"dynamic"``.
+    With everything off except ``enabled`` the guard only skips
+    non-finite steps; with *nothing* on the trainer builds the exact
+    pre-guard program.  Scale-of-1.0 and no-clip paths apply **no**
+    multiplies to gradients, so a guard-on clean run is bitwise
+    identical to guard-off (pinned by tests/test_resilience.py).
+    """
+
+    def __init__(self,
+                 clip_global_norm: Optional[float] = None,
+                 loss_scale: Any = None,
+                 init_scale: float = 2.0 ** 15,
+                 growth_factor: float = 2.0,
+                 backoff_factor: float = 0.5,
+                 growth_interval: int = 200,
+                 min_scale: float = 2.0 ** -14,
+                 max_scale: float = 2.0 ** 24,
+                 # --- divergence sentinel (host side) ---
+                 check_every: int = 25,
+                 window: int = 16,
+                 min_history: int = 4,
+                 spike_factor: float = 8.0,
+                 lr_backoff: float = 0.5,
+                 min_lr_scale: float = 1.0 / 64.0,
+                 rollback_after: int = 2,
+                 cooldown: int = 2):
+        if clip_global_norm is not None:
+            clip_global_norm = float(clip_global_norm)
+            if clip_global_norm <= 0:
+                raise ValueError("clip_global_norm must be positive")
+        if loss_scale is not None and loss_scale != "dynamic":
+            loss_scale = float(loss_scale)
+            if loss_scale <= 0:
+                raise ValueError("loss_scale must be positive or 'dynamic'")
+        self.clip_global_norm = clip_global_norm
+        self.loss_scale = loss_scale
+        self.init_scale = float(init_scale)
+        self.growth_factor = float(growth_factor)
+        self.backoff_factor = float(backoff_factor)
+        self.growth_interval = int(growth_interval)
+        self.min_scale = float(min_scale)
+        self.max_scale = float(max_scale)
+        self.check_every = int(check_every)
+        self.window = int(window)
+        self.min_history = int(min_history)
+        self.spike_factor = float(spike_factor)
+        self.lr_backoff = float(lr_backoff)
+        self.min_lr_scale = float(min_lr_scale)
+        self.rollback_after = int(rollback_after)
+        self.cooldown = int(cooldown)
+
+    # -- derived predicates (static: they select traced code paths) --
+    @property
+    def scaling(self) -> bool:
+        return self.loss_scale is not None
+
+    @property
+    def dynamic(self) -> bool:
+        return self.loss_scale == "dynamic"
+
+    def describe(self) -> Dict[str, Any]:
+        """Stable dict folded into the compiled program's cache key.
+
+        Only fields that change the *traced program* belong here;
+        sentinel knobs are host-side and deliberately excluded."""
+        return {
+            "clip_global_norm": self.clip_global_norm,
+            "loss_scale": ("dynamic" if self.dynamic
+                           else self.loss_scale),
+            "dynamic": (self.growth_factor, self.backoff_factor,
+                        self.growth_interval, self.min_scale,
+                        self.max_scale) if self.dynamic else None,
+        }
+
+
+def guard_env_enabled() -> Optional[bool]:
+    """Tri-state read of ``MXNET_TPU_GUARD`` (None = unset)."""
+    return _env_flag("MXNET_TPU_GUARD")
+
+
+def _loss_scale_from_env() -> Any:
+    raw = os.environ.get("MXNET_TPU_LOSS_SCALE")
+    if raw is None:
+        return None
+    raw = raw.strip().lower()
+    if raw in ("", "0", "off", "none"):
+        return None
+    if raw == "dynamic":
+        return "dynamic"
+    return float(raw)
+
+
+def resolve(guard: Optional[bool] = None,
+            clip_global_norm: Optional[float] = None,
+            loss_scale: Any = None,
+            **overrides: Any) -> Optional[GuardConfig]:
+    """Build the effective :class:`GuardConfig`, or ``None`` when every
+    defense is off.
+
+    Explicit arguments win; unset ones fall back to ``MXNET_TPU_GUARD``
+    / ``MXNET_TPU_LOSS_SCALE*``.  The guard auto-enables when clipping
+    or scaling is requested (they need the fused stats anyway)."""
+    if guard is None:
+        guard = guard_env_enabled()
+    if loss_scale is None:
+        loss_scale = _loss_scale_from_env()
+    if guard is False:
+        if clip_global_norm is not None or loss_scale is not None:
+            raise ValueError("guard=False conflicts with "
+                             "clip_global_norm/loss_scale (both ride on "
+                             "the fused grad stats)")
+        return None
+    if not guard and clip_global_norm is None and loss_scale is None:
+        return None
+    kwargs: Dict[str, Any] = dict(
+        clip_global_norm=clip_global_norm,
+        loss_scale=loss_scale,
+        init_scale=_env_float("MXNET_TPU_LOSS_SCALE_INIT", 2.0 ** 15),
+        growth_factor=_env_float("MXNET_TPU_LOSS_SCALE_GROWTH", 2.0),
+        backoff_factor=_env_float("MXNET_TPU_LOSS_SCALE_BACKOFF", 0.5),
+        growth_interval=int(_env_float("MXNET_TPU_LOSS_SCALE_INTERVAL",
+                                       200)),
+    )
+    kwargs.update(overrides)
+    return GuardConfig(**kwargs)
+
+
+# --------------------------------------------------------------------
+# In-graph pieces (imported lazily so `import mxnet_tpu` stays jax-free
+# on module import errors; trainer calls these inside traced code).
+# --------------------------------------------------------------------
+
+def init_state(cfg: GuardConfig) -> "collections.OrderedDict":
+    """Host-side initial guard state (numpy scalars, keyed STATE_KEYS)."""
+    scale = cfg.init_scale if cfg.dynamic else (
+        float(cfg.loss_scale) if cfg.scaling else 1.0)
+    out = collections.OrderedDict()
+    for k in STATE_KEYS:
+        if k in _INT_KEYS:
+            out[k] = np.zeros((), np.int32)
+        else:
+            out[k] = np.asarray(scale if k == "scale" else 0.0, np.float32)
+    return out
+
+
+def tree_sq_sum(grads) -> Any:
+    """f32 sum of squares over a gradient pytree — the single fused
+    statistic everything (finiteness, norm, clip) derives from."""
+    import jax
+    import jax.numpy as jnp
+    leaves = jax.tree_util.tree_leaves(grads)
+    total = jnp.float32(0.0)
+    for g in leaves:
+        total = total + jnp.sum(jnp.square(g.astype(jnp.float32)))
+    return total
+
+
+def state_update(state: Dict[str, Any], ok: Any, norm: Any,
+                 cfg: GuardConfig) -> Dict[str, Any]:
+    """Traced guard-state transition.  ``ok`` is the all-finite flag,
+    ``norm`` the effective (unscaled, post-rescale) global grad norm.
+
+    Overflow of the f32 square-sum itself reads as non-finite — that is
+    the semantics we want: a gradient too large to measure is a step we
+    must not take, and under dynamic scaling it backs the scale off."""
+    import jax.numpy as jnp
+    oki = ok.astype(jnp.int32)
+    new = dict(state)
+    new["skipped"] = state["skipped"] + (1 - oki)
+    new["norm_sum"] = (state["norm_sum"] +
+                       jnp.where(ok, norm, 0.0).astype(jnp.float32))
+    new["norm_cnt"] = state["norm_cnt"] + oki
+    if cfg.dynamic:
+        good = jnp.where(ok, state["good"] + 1, jnp.int32(0))
+        grow = good >= cfg.growth_interval
+        grown = jnp.minimum(state["scale"] * cfg.growth_factor,
+                            cfg.max_scale)
+        shrunk = jnp.maximum(state["scale"] * cfg.backoff_factor,
+                             cfg.min_scale)
+        new["scale"] = jnp.where(
+            ok, jnp.where(grow, grown, state["scale"]),
+            shrunk).astype(jnp.float32)
+        new["good"] = jnp.where(grow, jnp.int32(0), good)
+        new["overflows"] = state["overflows"] + (1 - oki)
+    return new
+
+
+def gate(ok: Any, new, old):
+    """``jnp.where(ok, new, old)`` over matching pytrees — the update
+    gate that leaves a bad step's state bitwise-unchanged."""
+    import jax
+    import jax.numpy as jnp
+    return jax.tree_util.tree_map(
+        lambda a, b: jnp.where(ok, a, b), new, old)
+
+
+# --------------------------------------------------------------------
+# Host-side divergence sentinel
+# --------------------------------------------------------------------
+
+class DivergenceSentinel(object):
+    """Rolling anomaly detector over periodic guard-state drains.
+
+    ``observe`` gets the window's mean gradient norm (None when every
+    step in the window was skipped), the number of skipped steps, and
+    the number of steps, and returns ``None`` / ``"backoff"`` /
+    ``"rollback"``.  A spike is a window mean above ``spike_factor``
+    times the rolling median of healthy windows; an all-skipped window
+    counts as an anomaly too (under dynamic scaling brief skip bursts
+    are normal, so the streak threshold — not a single window — drives
+    escalation).  After a rollback a cooldown suppresses re-triggering
+    while history refills.
+    """
+
+    def __init__(self, cfg: GuardConfig, logger=None):
+        self.cfg = cfg
+        self.logger = logger or _LOGGER
+        self.history: "collections.deque" = collections.deque(
+            maxlen=cfg.window)
+        self.anomaly_streak = 0
+        self.cooldown = 0
+        self.backoffs = 0
+        self.rollbacks = 0
+
+    def observe(self, norm_mean: Optional[float], skipped: int,
+                steps: int) -> Optional[str]:
+        if steps <= 0:
+            return None
+        if self.cooldown > 0:
+            self.cooldown -= 1
+            if norm_mean is not None:
+                self.history.append(norm_mean)
+            return None
+        anomaly = False
+        reason = ""
+        if skipped >= steps:
+            anomaly = True
+            reason = "all %d steps in window skipped" % steps
+        if (norm_mean is not None and
+                len(self.history) >= self.cfg.min_history):
+            med = statistics.median(self.history)
+            if med > 0.0 and norm_mean > self.cfg.spike_factor * med:
+                anomaly = True
+                reason = ("grad-norm spike %.3g vs rolling median %.3g"
+                          % (norm_mean, med))
+        if not anomaly:
+            self.anomaly_streak = 0
+            if norm_mean is not None:
+                self.history.append(norm_mean)
+            return None
+        self.anomaly_streak += 1
+        if self.anomaly_streak >= self.cfg.rollback_after:
+            self.anomaly_streak = 0
+            self.cooldown = self.cfg.cooldown
+            self.history.clear()
+            self.rollbacks += 1
+            self.logger.warning("Resilience sentinel: %s -> rollback",
+                                reason)
+            return "rollback"
+        self.backoffs += 1
+        self.logger.warning("Resilience sentinel: %s -> LR backoff",
+                            reason)
+        return "backoff"
+
+
+# --------------------------------------------------------------------
+# Legacy Module / FeedForward guard
+# --------------------------------------------------------------------
+
+class LegacyGuard(object):
+    """Skip/clip guard for the legacy per-device updater path.
+
+    ``prepare(per_device_grads)`` computes one fused square-sum per
+    device (a single jitted reduction over the whole gradient list) and
+    fetches all device scalars in one transfer.  It returns False when
+    the step must be skipped (any non-finite gradient anywhere);
+    otherwise per-device clip coefficients are staged and
+    ``grad_for(grad, dev)`` rescales lazily — a no-op dispatch when no
+    clipping is needed.  The legacy loop syncs per step regardless, so
+    this adds one scalar fetch, not a new synchronization point.
+    """
+
+    def __init__(self, clip_global_norm: Optional[float] = None,
+                 skip_nonfinite: bool = True,
+                 rescale_grad: float = 1.0, logger=None):
+        self.clip_global_norm = (None if clip_global_norm is None
+                                 else float(clip_global_norm))
+        self.skip_nonfinite = bool(skip_nonfinite)
+        self.rescale_grad = abs(float(rescale_grad)) or 1.0
+        self.logger = logger or _LOGGER
+        self.skipped_steps = 0
+        self.clipped_steps = 0
+        self._coefs: List[float] = []
+        self._warned = False
+        self._sq_fn = None
+
+    def _sq_sum(self, arrays):
+        import jax
+        if self._sq_fn is None:
+            self._sq_fn = jax.jit(tree_sq_sum)
+        return self._sq_fn(list(arrays))
+
+    def prepare(self, per_device_grads: Sequence[Sequence[Any]],
+                allow_clip: bool = True) -> bool:
+        """per_device_grads[k] = every grad buffer on device k (raw jax
+        arrays).  Returns whether the update should proceed."""
+        import jax
+        sqs = [self._sq_sum(gs) for gs in per_device_grads]
+        vals = np.asarray(jax.device_get(sqs), dtype=np.float64)
+        finite = bool(np.isfinite(vals).all())
+        if self.skip_nonfinite and not finite:
+            self.skipped_steps += 1
+            if not self._warned:
+                self.logger.warning(
+                    "non-finite gradient detected; skipping update "
+                    "(further skips counted on .skipped_steps)")
+                self._warned = True
+            from . import profiler
+            profiler.bump("resilience.legacy_skipped")
+            return False
+        self._coefs = [1.0] * len(vals)
+        if self.clip_global_norm is not None and allow_clip and finite:
+            clipped = False
+            for k, v in enumerate(vals):
+                norm = float(np.sqrt(v)) * self.rescale_grad
+                if norm > self.clip_global_norm:
+                    self._coefs[k] = self.clip_global_norm / max(
+                        norm, 1e-12)
+                    clipped = True
+            if clipped:
+                self.clipped_steps += 1
+        return True
+
+    def grad_for(self, grad, dev: int):
+        """Clip-rescaled gradient for device ``dev`` (NDArray in,
+        NDArray out; identity unless this step clips)."""
+        coef = self._coefs[dev] if dev < len(self._coefs) else 1.0
+        if coef >= 1.0:
+            return grad
+        from .ndarray import NDArray
+        return NDArray(grad.data * np.float32(coef), ctx=grad.ctx)
+
+
+def legacy_guard_for(optimizer, logger=None) -> Optional[LegacyGuard]:
+    """Build the legacy guard an optimizer asks for, or ``None``.
+
+    Activated by ``Optimizer(clip_global_norm=...)``,
+    ``Optimizer(skip_nonfinite=True)``, or ``MXNET_TPU_GUARD=1``."""
+    clip = getattr(optimizer, "clip_global_norm", None)
+    skip = getattr(optimizer, "skip_nonfinite", None)
+    if skip is None:
+        skip = bool(guard_env_enabled())
+    if clip is None and not skip:
+        return None
+    return LegacyGuard(clip_global_norm=clip, skip_nonfinite=skip,
+                       rescale_grad=getattr(optimizer, "rescale_grad",
+                                            1.0),
+                       logger=logger)

@@ -73,8 +73,8 @@ def test_ctc_impossible_label_is_inf():
 
 
 def test_warpctc_op_head():
-    """Op-level parity: softmax forward, CTC grad backward, grad ignores
-    the head cotangent (loss-head semantics)."""
+    """Op-level parity: softmax forward, CTC grad backward, cotangent
+    applied multiplicatively (loss-head contract)."""
     rng = np.random.RandomState(2)
     T, B, C, L = 6, 2, 5, 3
     op = get_op("WarpCTC")
@@ -87,10 +87,11 @@ def test_warpctc_op_head():
         np.asarray(out), np.asarray(jax.nn.softmax(data, axis=-1)),
         rtol=1e-6)
 
-    # backward: vjp with an arbitrary cotangent equals the CTC gradient
+    # backward: a ones cotangent equals the CTC gradient (reference
+    # behavior); a uniform cotangent scales it (loss-scaling contract)
     fwd = lambda d: op.forward(OpContext(), p, d, label)
     _, vjp = jax.vjp(fwd, data)
-    (g,) = vjp(jnp.full((T * B, C), 7.0, jnp.float32))  # ct ignored
+    (g,) = vjp(jnp.ones((T * B, C), jnp.float32))
 
     logits = data.reshape(T, B, C)
     labels = label.astype(jnp.int32).reshape(B, L)
@@ -98,6 +99,10 @@ def test_warpctc_op_head():
     np.testing.assert_allclose(np.asarray(g),
                                np.asarray(g_ref).reshape(T * B, C),
                                rtol=1e-5, atol=1e-7)
+    (g7,) = vjp(jnp.full((T * B, C), 7.0, jnp.float32))
+    np.testing.assert_allclose(np.asarray(g7),
+                               np.asarray(g_ref).reshape(T * B, C) * 7.0,
+                               rtol=1e-5, atol=1e-6)
 
 
 def test_warpctc_symbol_training():

@@ -290,13 +290,17 @@ def test_softmax_output_backward_semantics():
     out = op.forward(OpContext(), p, data, label)
     np.testing.assert_allclose(np.asarray(out),
                                np.asarray(jax.nn.softmax(data, axis=-1)))
-    # vjp with arbitrary cotangent returns (prob - onehot) regardless
+    # vjp with a ones cotangent returns (prob - onehot) — the reference
+    # backward; a uniform cotangent scales it (loss-scaling contract)
     _, vjp = jax.vjp(lambda d: op.forward(OpContext(), p, d, label), data)
-    (grad,) = vjp(jnp.full((4, 5), 123.0))
+    (grad,) = vjp(jnp.ones((4, 5)))
     expect = np.array(jax.nn.softmax(data, axis=-1))
     for i, l in enumerate([0, 1, 2, 3]):
         expect[i, l] -= 1.0
     np.testing.assert_allclose(np.asarray(grad), expect, rtol=1e-6)
+    (grad123,) = vjp(jnp.full((4, 5), 123.0))
+    np.testing.assert_allclose(np.asarray(grad123), expect * 123.0,
+                               rtol=1e-6)
 
 
 def test_softmax_output_ignore_label():
@@ -323,8 +327,10 @@ def test_regression_outputs():
         p = op.parse_params({})
         out, vjp = jax.vjp(lambda d: op.forward(OpContext(), p, d, label), data)
         np.testing.assert_allclose(np.asarray(out), fwd_ref)
-        (grad,) = vjp(jnp.zeros_like(data))  # head grad ignored
+        (grad,) = vjp(jnp.ones_like(data))  # ones = reference backward
         np.testing.assert_allclose(np.asarray(grad), grad_ref)
+        (grad2,) = vjp(jnp.full_like(data, 2.0))  # loss-scaling contract
+        np.testing.assert_allclose(np.asarray(grad2), grad_ref * 2.0)
 
 
 def test_makeloss():
@@ -333,8 +339,10 @@ def test_makeloss():
     p = op.parse_params({"grad_scale": 0.5})
     out, vjp = jax.vjp(lambda v: op.forward(OpContext(), p, v), x)
     np.testing.assert_allclose(np.asarray(out), np.asarray(x))
-    (grad,) = vjp(jnp.zeros_like(x))
+    (grad,) = vjp(jnp.ones_like(x))  # ones = reference backward
     np.testing.assert_allclose(np.asarray(grad), 0.5)
+    (grad3,) = vjp(jnp.full_like(x, 3.0))  # loss-scaling contract
+    np.testing.assert_allclose(np.asarray(grad3), 1.5)
 
 
 def test_crop():
@@ -400,14 +408,18 @@ def test_softmax_output_loss_mode():
     logp = np.asarray(jax.nn.log_softmax(data, axis=-1))
     expect = -logp[np.arange(6), label.astype(np.int32)]
     np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-6)
-    # gradient parity with the probs head, head-cotangent ignored in both
+    # gradient parity with the probs head under a ones cotangent; a
+    # uniform cotangent scales both the same way (loss-scaling contract)
     _, vjp_l = jax.vjp(lambda d: op.forward(OpContext(), p_loss, d, label),
                        data)
     _, vjp_p = jax.vjp(lambda d: op.forward(OpContext(), p_prob, d, label),
                        data)
-    (gl,) = vjp_l(jnp.full(label.shape, 7.0))
-    (gp,) = vjp_p(jnp.full(data.shape, 123.0))
+    (gl,) = vjp_l(jnp.ones(label.shape))
+    (gp,) = vjp_p(jnp.ones(data.shape))
     np.testing.assert_allclose(np.asarray(gl), np.asarray(gp), rtol=1e-6)
+    (gl7,) = vjp_l(jnp.full(label.shape, 7.0))
+    np.testing.assert_allclose(np.asarray(gl7), np.asarray(gp) * 7.0,
+                               rtol=1e-6)
 
 
 def test_softmax_output_loss_mode_ignore_and_multi():

@@ -182,3 +182,96 @@ def test_im2rec_tool(tmp_path):
     b = next(it)
     labels = sorted(b.label[0].asnumpy().tolist())
     assert labels == [0.0, 0.0, 0.0, 1.0, 1.0, 1.0]
+
+
+# -- corruption tolerance (chaos bit-flip tests) ------------------------
+
+def _write_plain_rec(tmp_path, n=50):
+    path = str(tmp_path / "chaos.rec")
+    w = recordio.MXRecordIO(path, "w")
+    payloads = [bytes([i]) * (i * 7 + 1) for i in range(n)]
+    for p in payloads:
+        w.write(p)
+    w.close()
+    return path, payloads
+
+
+def _read_all(r):
+    got = []
+    while True:
+        rec = r.read()
+        if rec is None:
+            return got
+        got.append(rec)
+
+
+def test_recordio_bitflip_tolerant_skip(tmp_path, caplog):
+    """A flipped magic bit loses exactly that record: the reader warns
+    once, counts every skip, and resyncs on the next valid header."""
+    import logging
+    from mxnet_tpu import chaos, profiler
+    path, payloads = _write_plain_rec(tmp_path)
+    offsets = chaos.record_offsets(path)  # before the first flip lands
+    chaos.flip_byte(path, offsets[7], 0x01)
+    chaos.flip_byte(path, offsets[23], 0x01)
+    profiler.reset_counters("recordio.")
+    r = recordio.MXRecordIO(path, "r")
+    with caplog.at_level(logging.WARNING, logger="mxnet_tpu.recordio"):
+        got = _read_all(r)
+    assert got == payloads[:7] + payloads[8:23] + payloads[24:]
+    assert r.corrupt_count == 2
+    assert profiler.counter("recordio.corrupt_records") == 2
+    warns = [rec for rec in caplog.records
+             if "corrupt record" in rec.getMessage()]
+    assert len(warns) == 1  # warn once, count the rest
+    r.close()
+
+
+def test_recordio_bitflip_strict_raises(tmp_path, monkeypatch):
+    from mxnet_tpu import chaos
+    from mxnet_tpu.base import MXNetError
+    path, payloads = _write_plain_rec(tmp_path, n=10)
+    chaos.corrupt_record(path, 4)
+    r = recordio.MXRecordIO(path, "r", strict=True)
+    for _ in range(4):
+        assert r.read() is not None
+    with pytest.raises(MXNetError):
+        r.read()
+    r.close()
+    # MXNET_TPU_RECORDIO_STRICT flips the default
+    monkeypatch.setenv("MXNET_TPU_RECORDIO_STRICT", "1")
+    r2 = recordio.MXRecordIO(path, "r")
+    assert r2.strict
+    with pytest.raises(MXNetError):
+        _read_all(r2)
+    r2.close()
+    monkeypatch.setenv("MXNET_TPU_RECORDIO_STRICT", "0")
+    r3 = recordio.MXRecordIO(path, "r")
+    assert not r3.strict
+    assert _read_all(r3) == payloads[:4] + payloads[5:]
+    r3.close()
+
+
+def test_recordio_corruption_through_eof(tmp_path):
+    """Corruption in the final record cannot resync — the reader returns
+    None (clean end) and still counts the loss."""
+    from mxnet_tpu import chaos
+    path, payloads = _write_plain_rec(tmp_path, n=12)
+    chaos.corrupt_record(path, 11)
+    r = recordio.MXRecordIO(path, "r")
+    assert _read_all(r) == payloads[:11]
+    assert r.corrupt_count == 1
+    r.close()
+
+
+def test_image_record_iter_surfaces_corrupt_count(tmp_path):
+    """ImageRecordIter rides the tolerant reader and exposes the skip
+    counter; a single flipped bit no longer kills the epoch."""
+    from mxnet_tpu import chaos
+    rec, idx = _write_image_dataset(tmp_path)
+    chaos.corrupt_record(rec, 5)
+    it = ImageRecordIter(path_imgrec=rec, path_imgidx=idx,
+                         data_shape=(3, 8, 8), batch_size=6)
+    n = sum(b.data[0].shape[0] for b in it)
+    assert n == 24
+    assert it.corrupt_records >= 1

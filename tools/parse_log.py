@@ -9,6 +9,11 @@ Speedometer throughput — and prints a per-epoch markdown table.
 outputs: for every network present in both, a per-phase table of
 ms/step deltas (B - A) and percentages — the regression-triage view for
 step-overhead changes.
+
+``--diff-resilience A B`` diffs the training-guardrail epoch counters
+(``Epoch[N] Resilience: skipped=... overflows=... rollbacks=...
+loss-scale=... lr-scale=...``) of two runs — the triage view for
+stability changes (docs/resilience.md).
 """
 import argparse
 import json
@@ -24,6 +29,13 @@ VAL_RE = re.compile(
 TRAIN_RE = re.compile(
     r"Epoch\[(\d+)\].*?(?:Mesh-)?Train-([\w-]+)=([\d.eE+-]+)")
 SPEED_RE = re.compile(r"Epoch\[(\d+)\].*?Speed: ([\d.]+) samples/sec")
+# "Epoch[2] Resilience: skipped=1 overflows=0 rollbacks=0
+#  loss-scale=512 lr-scale=0.5" (ShardedTrainer.fit, guard on)
+RESIL_RE = re.compile(
+    r"Epoch\[(\d+)\] Resilience: skipped=(\d+) overflows=(\d+) "
+    r"rollbacks=(\d+) loss-scale=([\d.eE+-]+) lr-scale=([\d.eE+-]+)")
+RESIL_KEYS = ("skipped", "overflows", "rollbacks", "loss-scale",
+              "lr-scale")
 
 
 def parse(lines):
@@ -42,6 +54,10 @@ def parse(lines):
         m = SPEED_RE.search(line)
         if m:
             speeds[int(m.group(1))].append(float(m.group(2)))
+        m = RESIL_RE.search(line)
+        if m:
+            for i, key in enumerate(RESIL_KEYS):
+                rows[int(m.group(1))][key] = float(m.group(2 + i))
     for epoch, sp in speeds.items():
         rows[epoch]["speed"] = sum(sp) / len(sp)
     return rows
@@ -90,15 +106,64 @@ def diff_profiles(path_a, path_b):
     return 0
 
 
+def read_resilience(path):
+    """{epoch: {counter: value}} from a run's Resilience epoch lines."""
+    out = {}
+    with open(path) as f:
+        for line in f:
+            m = RESIL_RE.search(line)
+            if m:
+                out[int(m.group(1))] = {
+                    key: float(m.group(2 + i))
+                    for i, key in enumerate(RESIL_KEYS)}
+    return out
+
+
+def diff_resilience(path_a, path_b):
+    """Per-epoch guardrail-counter comparison of two runs (B - A):
+    the triage view for 'did this change make training less stable'."""
+    a, b = read_resilience(path_a), read_resilience(path_b)
+    if not a and not b:
+        print("no Resilience epoch lines in either log (guard off?)",
+              file=sys.stderr)
+        return 1
+    epochs = sorted(set(a) | set(b))
+    print("| epoch | " + " | ".join(
+        f"{k} A | {k} B | Δ" for k in RESIL_KEYS) + " |")
+    print("|" + "---|" * (1 + 3 * len(RESIL_KEYS)))
+    for ep in epochs:
+        cells = []
+        for k in RESIL_KEYS:
+            va = a.get(ep, {}).get(k)
+            vb = b.get(ep, {}).get(k)
+            cells.append("" if va is None else f"{va:g}")
+            cells.append("" if vb is None else f"{vb:g}")
+            cells.append(f"{vb - va:+g}"
+                         if va is not None and vb is not None else "")
+        print(f"| {ep} | " + " | ".join(cells) + " |")
+    for name, run in (("A", a), ("B", b)):
+        if run:
+            last = run[max(run)]
+            print(f"{name} final: " + " ".join(
+                f"{k}={last[k]:g}" for k in RESIL_KEYS), file=sys.stderr)
+    return 0
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("logfile", nargs="?", help="default: stdin")
     ap.add_argument("--diff-profile", nargs=2, metavar=("A", "B"),
                     help="diff two bench.py --profile-step outputs "
                     "(per-phase ms + %% deltas, B relative to A)")
+    ap.add_argument("--diff-resilience", nargs=2, metavar=("A", "B"),
+                    help="diff the guardrail counters (skipped/overflows/"
+                    "rollbacks/loss-scale/lr-scale) of two runs' epoch "
+                    "logs, B relative to A")
     args = ap.parse_args()
     if args.diff_profile:
         return diff_profiles(*args.diff_profile)
+    if args.diff_resilience:
+        return diff_resilience(*args.diff_resilience)
     lines = (open(args.logfile).readlines() if args.logfile
              else sys.stdin.readlines())
     rows = parse(lines)
