@@ -96,7 +96,8 @@ class ChaosIter(object):
     """Iterator wrapper injecting faults at fixed global batch indices.
 
     Poisoning replaces every array in ``batch.data`` (``DataBatch``) or
-    every value of a dict batch; labels are left alone so metric code
+    every float-typed value of a dict batch; labels are left alone
+    (integer/bool arrays in a dict batch are skipped) so metric code
     stays exercised.  ``injected`` counts firings per kind."""
 
     def __init__(self, data_iter, spec: ChaosSpec, logger=None):
@@ -123,7 +124,17 @@ class ChaosIter(object):
 
     def _poison_batch(self, batch, value: float):
         if isinstance(batch, dict):
-            return {k: _poison_array(v, value) for k, v in batch.items()}
+            # poison only float-typed values: integer/bool arrays are
+            # labels/ids (np.full with NaN into an int dtype raises),
+            # mirroring the DataBatch path which only touches .data
+            out = {}
+            for k, v in batch.items():
+                data = getattr(v, "data", v)
+                dtype = np.dtype(getattr(data, "dtype", None) or
+                                 np.asarray(data).dtype)
+                out[k] = (v if dtype.kind in "iub"
+                          else _poison_array(v, value))
+            return out
         if hasattr(batch, "data"):  # DataBatch
             import copy
             out = copy.copy(batch)

@@ -221,6 +221,11 @@ class ShardedTrainer:
         if clip_global_norm is None:
             clip_global_norm = getattr(self.optimizer, "clip_global_norm",
                                        None)
+        # legacy-spelling parity: Optimizer(skip_nonfinite=True) turns
+        # the guard on here exactly as it does on Module/FeedForward
+        if guard is None and getattr(self.optimizer, "skip_nonfinite",
+                                     None):
+            guard = True
         self._resil = resilience.resolve(guard=guard,
                                          clip_global_norm=clip_global_norm,
                                          loss_scale=loss_scale,
@@ -232,6 +237,13 @@ class ShardedTrainer:
         self._lr_scale = 1.0
         self._rollbacks = 0
         self._resil_drained: Dict[str, Any] = {}
+        # cumulative base for the windowed guard counters: each sentinel
+        # drain folds the on-device values in here (float64/Python int)
+        # and zeroes them on device, so the f32 norm_sum accumulator
+        # stays window-sized and per-step increments never fall below
+        # f32 resolution on long runs
+        self._resil_base: Dict[str, Any] = {k: 0 for k
+                                            in resilience.WINDOW_KEYS}
         self._sentinel = None
         self._rollback_hook = None  # test/chaos hook: runs pre-rollback
         self._bound = False
@@ -404,6 +416,7 @@ class ShardedTrainer:
             self._guard_state = {
                 k: self._global_put(v, rep)
                 for k, v in resilience.init_state(self._resil).items()}
+            self._resil_base = {k: 0 for k in resilience.WINDOW_KEYS}
         self._num_update = opt.begin_num_update
         self._lr_mult = {n: opt.lr_mult.get(n, 1.0) for n in self._param_names}
         self._wd_mult = {}
@@ -1239,13 +1252,15 @@ class ShardedTrainer:
         if self._guard_state is not None:
             # loss scale + guard counters travel with the checkpoint, so a
             # resumed bf16 run continues at its working scale instead of
-            # re-walking the growth schedule from init_scale
+            # re-walking the growth schedule from init_scale.  Windowed
+            # counters are saved cumulatively (host base + device window)
             vals = jax.device_get(self._guard_state)
-            meta["resilience"] = {
-                k: (float(np.asarray(v))
-                    if np.asarray(v).dtype.kind == "f"
-                    else int(np.asarray(v)))
-                for k, v in vals.items()}
+            res = {}
+            for k, v in vals.items():
+                a = np.asarray(v)
+                val = float(a) if a.dtype.kind == "f" else int(a)
+                res[k] = val + self._resil_base.get(k, 0)
+            meta["resilience"] = res
         if extra_meta:
             meta.update(extra_meta)
         return meta
@@ -1307,14 +1322,24 @@ class ShardedTrainer:
             self._set_base_key(_key_from_meta(meta["rng_key"]))
         if self._resil is not None and "resilience" in meta:
             # same pinned replicated placement as bind() — the restored
-            # guard state slots into the compiled program without a trace
+            # guard state slots into the compiled program without a
+            # trace.  Cumulative counters land in the host-side base
+            # (full float64/int precision) with zeroed device windows,
+            # so the f32 accumulators restart window-sized; scale and
+            # the good-step streak stay live on device.
             rep = replicated(self.mesh)
             base = resilience.init_state(self._resil)
             saved = meta["resilience"]
-            self._guard_state = {
-                k: self._global_put(
-                    np.asarray(saved.get(k, base[k]), base[k].dtype), rep)
-                for k in resilience.STATE_KEYS}
+            self._guard_state = {}
+            self._resil_base = {k: 0 for k in resilience.WINDOW_KEYS}
+            for k in resilience.STATE_KEYS:
+                v = saved.get(k, base[k])
+                if k in resilience.WINDOW_KEYS:
+                    self._resil_base[k] = (float(v) if k == "norm_sum"
+                                           else int(v))
+                    v = np.zeros((), base[k].dtype)
+                self._guard_state[k] = self._global_put(
+                    np.asarray(v, base[k].dtype), rep)
         self.logger.info("restore_state: resumed at update %d from %s",
                          self._num_update, manager.step_path(step))
         return meta, step
@@ -1333,23 +1358,45 @@ class ShardedTrainer:
 
     def resilience_stats(self) -> Dict[str, Any]:
         """One-fetch snapshot of the guard counters (empty dict when the
-        guard is off).  Counters are cumulative since bind/restore; the
-        sentinel diffs successive snapshots, so reading them here never
-        resets anything on device."""
+        guard is off).  Counters are cumulative since bind/restore:
+        each value is the host-side base (counters folded off-device by
+        past sentinel drains, float64/int precision) plus the current
+        on-device window.  Reading them here never resets anything."""
         if self._guard_state is None:
             return {}
         vals = jax.device_get(self._guard_state)
+        base = self._resil_base
         return {
-            "skipped_steps": int(vals["skipped"]),
-            "overflow_steps": int(vals["overflows"]),
+            "skipped_steps": base["skipped"] + int(vals["skipped"]),
+            "overflow_steps": base["overflows"] + int(vals["overflows"]),
             "good_steps": int(vals["good"]),
             "loss_scale": float(vals["scale"]),
-            "norm_sum": float(vals["norm_sum"]),
-            "norm_steps": int(vals["norm_cnt"]),
+            "norm_sum": base["norm_sum"] + float(vals["norm_sum"]),
+            "norm_steps": base["norm_cnt"] + int(vals["norm_cnt"]),
             "lr_scale": self._lr_scale,
             "rollbacks": self._rollbacks,
             "num_update": self._num_update,
         }
+
+    def _fold_guard_counters(self, stats: Dict[str, Any]) -> None:
+        """Fold the windowed on-device counters into the host-side
+        cumulative base and zero them on device.  ``stats`` is the
+        snapshot just fetched by :meth:`resilience_stats` (already
+        base + device, so it simply becomes the new base).  Bounds the
+        f32 ``norm_sum`` accumulator to one drain window — a cumulative
+        f32 sum would lose per-step resolution after ~1e7 steps and
+        blind the divergence sentinel on exactly the long runs it
+        guards.  The zeros keep the pinned replicated placement, so the
+        compiled step program re-dispatches without a trace."""
+        self._resil_base = {"skipped": stats["skipped_steps"],
+                            "overflows": stats["overflow_steps"],
+                            "norm_sum": stats["norm_sum"],
+                            "norm_cnt": stats["norm_steps"]}
+        rep = replicated(self.mesh)
+        for k in resilience.WINDOW_KEYS:
+            dt = self._guard_state[k].dtype
+            self._guard_state[k] = self._global_put(
+                np.zeros((), dt), rep)
 
     def _sentinel_poll(self, manager=None) -> Optional[str]:
         """Drain the guard counters and feed the divergence sentinel.
@@ -1363,6 +1410,7 @@ class ShardedTrainer:
         stats = self.resilience_stats()
         if not stats:
             return None
+        self._fold_guard_counters(stats)
         last, self._resil_drained = self._resil_drained, stats
         if not last:
             return None  # first drain just baselines the counters

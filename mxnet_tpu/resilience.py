@@ -16,11 +16,13 @@ and destroy the donation-complete dispatch loop.  The pieces here:
 
 guard state (``init_state`` / ``state_update``)
     Six replicated device scalars (loss scale, good-step streak, and
-    cumulative skipped / overflow / grad-norm counters) threaded through
+    windowed skipped / overflow / grad-norm counters) threaded through
     the step program exactly like ``num_update``: passed as a pinned
     program argument, returned updated, never synced inside the loop.
-    Counters are cumulative; hosts diff against their last drain, so
-    draining costs one small fetch and resetting costs nothing.
+    Each host drain folds the windowed counters (``WINDOW_KEYS``) into
+    a float64/int cumulative base and zeroes them on device, so the f32
+    ``norm_sum`` accumulator never grows past one window and per-step
+    increments keep full resolution on arbitrarily long runs.
 
 ``DivergenceSentinel``
     Host-side rolling detector fed by periodic guard-state drains in
@@ -55,12 +57,23 @@ STATE_KEYS = ("scale", "good", "skipped", "overflows", "norm_sum", "norm_cnt")
 
 _INT_KEYS = frozenset(("good", "skipped", "overflows", "norm_cnt"))
 
+# Windowed counters: periodically folded into a host-side float64/int
+# cumulative base and zeroed on device (ShardedTrainer._sentinel_poll),
+# so the on-device f32 accumulators only ever hold one drain window's
+# worth of mass — per-step increments never fall below f32 resolution
+# no matter how long the run.  "scale"/"good" carry live schedule state
+# and are never reset.
+WINDOW_KEYS = ("skipped", "overflows", "norm_sum", "norm_cnt")
+
 
 def _env_flag(name: str, default: Optional[bool] = None) -> Optional[bool]:
     raw = os.environ.get(name)
     if raw is None:
         return default
-    return raw.strip().lower() not in ("0", "false", "off", "")
+    raw = raw.strip().lower()
+    if not raw:
+        return default  # `export VAR=` (empty) behaves like unset
+    return raw not in ("0", "false", "off")
 
 
 def _env_float(name: str, default: float) -> float:
@@ -408,6 +421,16 @@ class LegacyGuard(object):
             if clipped:
                 self.clipped_steps += 1
         return True
+
+    def share_coef(self, num_device: int) -> None:
+        """Broadcast device 0's clip coefficient to every device.
+
+        For aggregated (replica-identical) gradients — the post-pull
+        kvstore path — stats are computed from a single device's copy;
+        applying per-device coefficients there would permanently diverge
+        the replicated parameter copies."""
+        coef = self._coefs[0] if self._coefs else 1.0
+        self._coefs = [coef] * num_device
 
     def grad_for(self, grad, dev: int):
         """Clip-rescaled gradient for device ``dev`` (NDArray in,

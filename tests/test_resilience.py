@@ -94,6 +94,11 @@ def test_resolve_env_fallback(monkeypatch):
     assert resilience.resolve() is not None
     monkeypatch.setenv("MXNET_TPU_GUARD", "0")
     assert resilience.resolve() is None
+    # an EMPTY var is unset, not an explicit False: it must not veto a
+    # clip request (which auto-enables the guard)
+    monkeypatch.setenv("MXNET_TPU_GUARD", "")
+    assert resilience.resolve() is None
+    assert resilience.resolve(clip_global_norm=1.0) is not None
     monkeypatch.delenv("MXNET_TPU_GUARD", raising=False)
     monkeypatch.setenv("MXNET_TPU_LOSS_SCALE", "dynamic")
     monkeypatch.setenv("MXNET_TPU_LOSS_SCALE_INIT", "1024")
@@ -306,6 +311,62 @@ def test_loss_scale_state_roundtrip(tmp_path):
     mgr.close()
 
 
+def test_sharded_skip_nonfinite_optimizer_spelling():
+    """Optimizer(skip_nonfinite=True) activates the guard on the sharded
+    trainer too — parity with the legacy Module/FeedForward spelling."""
+    opt = mx.optimizer.SGD(learning_rate=0.1, skip_nonfinite=True)
+    tr = _trainer(optimizer=opt)
+    assert tr._resil is not None
+    x, y = _toy_batch()
+    tr.step({"data": x, "softmax_label": y})
+    before = _params_np(tr)
+    xbad = x.copy()
+    xbad[0, 0] = np.nan
+    tr.step({"data": xbad, "softmax_label": y})
+    after = _params_np(tr)
+    for n in before:
+        assert np.array_equal(before[n], after[n]), n
+    assert tr.resilience_stats()["skipped_steps"] == 1
+
+
+def test_sentinel_drain_folds_counters(tmp_path):
+    """Each sentinel drain folds the windowed device counters into the
+    host-side float64/int base and zeroes them on device, so the f32
+    norm_sum accumulator stays window-sized on long runs — while
+    resilience_stats() and checkpoints keep reporting cumulative
+    totals."""
+    tr = _trainer(guard=True, guard_params={"check_every": 1})
+    x, y = _toy_batch(seed=11)
+    for _ in range(3):
+        tr.step({"data": x, "softmax_label": y})
+    st = tr.resilience_stats()
+    assert st["norm_steps"] == 3 and st["norm_sum"] > 0
+    tr._sentinel_poll()
+    # device window zeroed...
+    assert float(jax.device_get(tr._guard_state["norm_sum"])) == 0.0
+    assert int(jax.device_get(tr._guard_state["norm_cnt"])) == 0
+    # ...but the public stats are still cumulative
+    st2 = tr.resilience_stats()
+    assert st2["norm_steps"] == 3
+    assert st2["norm_sum"] == pytest.approx(st["norm_sum"], rel=1e-6)
+    # a base far past f32 increment-resolution still registers new steps
+    tr._resil_base["norm_sum"] = 3e7
+    tr.step({"data": x, "softmax_label": y})
+    st3 = tr.resilience_stats()
+    assert st3["norm_sum"] > 3e7  # f32 cumulative would absorb this
+    assert st3["norm_steps"] == 4
+    # cumulative totals survive a checkpoint round trip post-fold
+    mgr = CheckpointManager(str(tmp_path))
+    tr.save_state(mgr)
+    mgr.wait_until_finished()
+    tr2 = _trainer(seed=42, guard=True, guard_params={"check_every": 1})
+    tr2.restore_state(mgr)
+    st4 = tr2.resilience_stats()
+    assert st4["norm_steps"] == 4
+    assert st4["norm_sum"] == pytest.approx(st3["norm_sum"], rel=1e-6)
+    mgr.close()
+
+
 def test_spike_backoff_rollback_resume_no_recompile(tmp_path):
     """Induced loss spike -> LR backoff -> checkpoint rollback ->
     training resumes with the cached step program (no recompile)."""
@@ -461,6 +522,53 @@ def test_legacy_clip_global_norm_parity(path):
     np.testing.assert_allclose(ratios, ratios[0], rtol=0.05)
 
 
+def test_legacy_kvstore_clip_shared_post_aggregation():
+    """With a kvstore, the guard runs AFTER the pull: the clip threshold
+    is calibrated on the AGGREGATED gradient norm and one shared
+    coefficient is applied on every device — per-device coefficients
+    over replica-identical aggregated grads would permanently diverge
+    the parameter copies."""
+    from mxnet_tpu import optimizer as opt_mod
+    from mxnet_tpu.model import _update_params
+
+    num_device = 2
+    w0 = np.ones((4,), np.float32)
+    # deliberately unequal per-device grads: per-device norms (6, 2)
+    # differ from the aggregated norm (8)
+    g_per_dev = [np.full((4,), 3.0, np.float32),
+                 np.full((4,), 1.0, np.float32)]
+    clip = 1.0
+
+    kv = mx.kvstore.create("local")
+    kv.init(0, mx.nd.array(w0))
+    params = [[mx.nd.array(w0.copy()) for _ in range(num_device)]]
+    grads = [[mx.nd.array(g) for g in g_per_dev]]
+    opt = mx.optimizer.SGD(learning_rate=0.1, clip_global_norm=clip)
+    guard = resilience.LegacyGuard(clip_global_norm=clip)
+    _update_params(params, grads, opt_mod.get_updater(opt), num_device,
+                   kvstore=kv, guard=guard)
+    agg = g_per_dev[0] + g_per_dev[1]
+    coef = clip / float(np.linalg.norm(agg))
+    expect = w0 - 0.1 * agg * coef
+    np.testing.assert_array_equal(params[0][0].asnumpy(),
+                                  params[0][1].asnumpy())
+    np.testing.assert_allclose(params[0][0].asnumpy(), expect, rtol=1e-5)
+    assert guard.clipped_steps == 1
+
+    # a NaN on ONE device still skips: non-finiteness survives the sum
+    kv2 = mx.kvstore.create("local")
+    kv2.init(0, mx.nd.array(w0))
+    params = [[mx.nd.array(w0.copy()) for _ in range(num_device)]]
+    bad = [np.full((4,), np.nan, np.float32),
+           np.full((4,), 1.0, np.float32)]
+    grads = [[mx.nd.array(g) for g in bad]]
+    guard2 = resilience.LegacyGuard()
+    _update_params(params, grads, opt_mod.get_updater(opt), num_device,
+                   kvstore=kv2, guard=guard2)
+    np.testing.assert_array_equal(params[0][0].asnumpy(), w0)
+    assert guard2.skipped_steps == 1
+
+
 def test_legacy_guard_off_is_identity():
     """No clip, no skip request, no env -> legacy_guard_for returns None
     and the update path is byte-for-byte the old code."""
@@ -503,6 +611,19 @@ def test_chaos_iter_injects_across_reset():
     with pytest.raises(chaos.ChaosError):
         ci.next()  # global index 4
     assert ci.injected == {"nan": 1, "overflow": 0, "crash": 1}
+
+
+def test_chaos_dict_batch_skips_int_labels():
+    """Dict batches: float values are poisoned, integer labels are left
+    alone (and the int path must not crash on np.full with NaN)."""
+    ci = chaos.ChaosIter(iter([]), chaos.ChaosSpec.parse("nan:0"))
+    batch = {"data": np.ones((2, 3), np.float32),
+             "softmax_label": np.arange(2, dtype=np.int32)}
+    out = ci._poison_batch(batch, float("nan"))
+    assert np.isnan(out["data"]).all()
+    np.testing.assert_array_equal(out["softmax_label"],
+                                  batch["softmax_label"])
+    assert out["softmax_label"].dtype == np.int32
 
 
 def test_chaos_maybe_wrap_env(monkeypatch):
