@@ -570,6 +570,90 @@ def bench_resilience(args):
     return rows
 
 
+def bench_audit(args):
+    """--audit: static program audit + the HBM-pass measuring stick.
+
+    Traces (never executes) the acceptance step programs — the default
+    FC trainer (sgd+momentum), the transformer-LM trainer (adam), and
+    the LM with the full guardrail stack — through
+    ``mxnet_tpu.analysis.audit_trainer`` and records the per-flat-grad-
+    bucket HBM pass count.  This is the baseline the fused-update
+    ROADMAP item must beat: a perfectly fused update touches each
+    bucket once (1 read / 1 write); every extra count is one more full
+    sweep of the gradient bytes through HBM per step.  The audit must
+    also be CLEAN (zero unsuppressed findings) — a finding here is a
+    real hazard in a shipped step program, and the row goes red.
+    Results land in ``BENCH_r07.json`` next to this script.
+    """
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import analysis, models
+
+    def fc_sym():
+        data = mx.symbol.Variable("data")
+        net = mx.symbol.FullyConnected(data=data, num_hidden=32, name="fc1")
+        net = mx.symbol.Activation(data=net, act_type="relu")
+        net = mx.symbol.FullyConnected(data=net, num_hidden=10, name="fc2")
+        return mx.symbol.SoftmaxOutput(data=net, name="softmax")
+
+    B, L, V = 8, 16, 128
+    lm_kw = dict(vocab_size=V, num_layers=2, d_model=64, heads=2,
+                 batch_size=B, seq_len=L)
+    configs = [
+        ("fc sgd-momentum", fc_sym, {"data": (16, 8)},
+         {"softmax_label": (16,)},
+         dict(optimizer="sgd",
+              optimizer_params={"learning_rate": 0.1, "momentum": 0.9})),
+        ("transformer-lm adam", lambda: models.get_symbol(
+            "transformer-lm", **lm_kw), {"data": (B, L)},
+         {"softmax_label": (B, L)},
+         dict(optimizer="adam",
+              optimizer_params={"learning_rate": 1e-3})),
+        ("transformer-lm adam+guard+clip+dyn-scale", lambda: models.get_symbol(
+            "transformer-lm", **lm_kw), {"data": (B, L)},
+         {"softmax_label": (B, L)},
+         dict(optimizer="adam", optimizer_params={"learning_rate": 1e-3},
+              guard=True, clip_global_norm=1.0, loss_scale="dynamic")),
+    ]
+
+    rows = []
+    for name, make_sym, dshapes, lshapes, kw in configs:
+        from mxnet_tpu.parallel import ShardedTrainer, make_mesh
+        mx.random.seed(7)
+        tr = ShardedTrainer(make_sym(),
+                            mesh=make_mesh({"data": len(jax.devices())}),
+                            **kw)
+        tr.bind(data_shapes=dshapes, label_shapes=lshapes)
+        t0 = time.perf_counter()
+        report = analysis.audit_trainer(tr, programs=("train",))
+        elapsed = time.perf_counter() - t0
+        hbm = report.metrics.get("trainer.train", {}).get("hbm_passes", {})
+        buckets = hbm.get("buckets", [])
+        rows.append({
+            "metric": f"grad-bucket HBM passes ({name}, audited "
+                      "train step)",
+            "value": hbm.get("max_reads"),
+            "unit": "reads/bucket/step",
+            "vs_baseline": None,
+            "writes_per_bucket": hbm.get("max_writes"),
+            "buckets": len(buckets),
+            "bucket_bytes": [b["bytes"] for b in buckets],
+            "clean": report.clean,
+            "findings": len(report.unsuppressed()),
+            "target": "CLEAN; fused update = 1 read/1 write",
+            "pass": bool(report.clean),
+            "audit_s": round(elapsed, 2),
+            "n_devices": len(jax.devices()),
+        })
+        print(json.dumps(rows[-1]))
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "BENCH_r07.json")
+    with open(out, "w") as f:
+        json.dump(rows, f, indent=2)
+        f.write("\n")
+    return rows
+
+
 def bench_compile(args):
     """--compile: cold-start elimination (docs/perf.md r7).
 
@@ -801,6 +885,11 @@ def main():
                     "guard-off vs guard-on (fused non-finite guard + "
                     "clip + dynamic loss scaling) on the 8-device CPU "
                     "mesh; target <2%% (docs/resilience.md)")
+    ap.add_argument("--audit", action="store_true",
+                    help="statically audit the acceptance step programs "
+                    "(mxnet_tpu.analysis) and record grad-bucket HBM "
+                    "pass counts -> BENCH_r07.json "
+                    "(docs/static_analysis.md)")
     ap.add_argument("--compile", action="store_true",
                     help="bench cold-start elimination: cold vs warm "
                     "trainer attach through the persistent program "
@@ -812,7 +901,7 @@ def main():
     if args.grad_compression == "none":
         args.grad_compression = None
 
-    if args.compile or args.resilience:
+    if args.compile or args.resilience or args.audit:
         # acceptance config is the 8-virtual-device CPU mesh; only set
         # when the caller hasn't picked a platform (jax is imported
         # lazily, so this is early enough)
@@ -821,6 +910,8 @@ def main():
             "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
         if args.compile:
             bench_compile(args)
+        elif args.audit:
+            bench_audit(args)
         else:
             bench_resilience(args)
         return 0

@@ -62,6 +62,7 @@ from . import profiler
 from . import resilience
 from . import chaos
 from . import compile_cache
+from . import analysis
 from . import visualization
 from . import visualization as viz
 
@@ -76,5 +77,5 @@ __all__ = [
     "kvstore", "executor_manager", "model", "FeedForward", "lr_scheduler",
     "Initializer", "Uniform", "Normal", "Xavier", "Orthogonal", "Optimizer",
     "save_checkpoint", "load_checkpoint", "checkpoint", "CheckpointManager",
-    "compile_cache", "resilience", "chaos",
+    "compile_cache", "resilience", "chaos", "analysis",
 ]

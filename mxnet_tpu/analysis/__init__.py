@@ -1,0 +1,31 @@
+"""Static analysis for mxnet_tpu: a jaxpr/HLO program auditor and a
+framework-aware repo linter (``tools/staticcheck.py``; rule catalogue in
+``docs/static_analysis.md``).
+
+Quick start::
+
+    from mxnet_tpu import analysis
+    report = analysis.audit_trainer(trainer)        # typed findings
+    analysis.assert_program_clean(trainer)          # pytest helper
+    report = analysis.lint_paths(repo_root)         # AST linter
+"""
+
+from .findings import (Finding, Report, RULES, SCHEMA_VERSION,
+                       apply_cli, apply_inline, parse_inline_suppressions)
+from .program import (AuditConfig, assert_program_clean, audit_executor,
+                      audit_module, audit_on_compile, audit_optimizer,
+                      audit_traced, audit_trainer, mark_grads, tag,
+                      update_passes)
+from .source import (ENV_PREFIX, documented_env_vars, env_reads_in_source,
+                     lint_file, lint_paths)
+
+__all__ = [
+    "Finding", "Report", "RULES", "SCHEMA_VERSION",
+    "apply_cli", "apply_inline", "parse_inline_suppressions",
+    "AuditConfig", "assert_program_clean", "audit_executor",
+    "audit_module", "audit_on_compile", "audit_optimizer",
+    "audit_traced", "audit_trainer", "mark_grads", "tag",
+    "update_passes",
+    "ENV_PREFIX", "documented_env_vars", "env_reads_in_source",
+    "lint_file", "lint_paths",
+]

@@ -1,0 +1,553 @@
+"""Framework-aware AST linter over the ``mxnet_tpu/`` sources.
+
+Rules (catalogue in docs/static_analysis.md):
+
+- ``source.host-sync``        ``.asnumpy()``/``.asscalar()``/``float()``/
+                              ``np.*`` applied to a *traced* value inside
+                              a jitted/scanned/vjp'd function
+- ``source.nondet``           ``time.*``/``random.*``/``np.random.*``/
+                              ``datetime.now`` inside traced code
+- ``source.env-undocumented`` ``os.environ`` reads of ``MXNET_TPU_*``
+                              variables missing from docs/env_vars.md
+- ``source.env-stale``        documented variables nothing reads
+- ``source.donated-mutation`` reading a buffer after it was donated
+
+Traced-region detection is conservative: a function is traced when it is
+decorated with / passed to a tracing entry point (``jax.jit``,
+``jax.lax.scan``, ``jax.vjp``, ``shard_map``, ...) *in the same file*,
+when it is nested inside a traced function, or when it carries an
+explicit ``# staticcheck: traced`` directive.  Inside traced functions a
+simple taint walk follows the parameters; accessing ``.shape``/
+``.dtype``/``.ndim``/``.size`` *untaints* (shape math via ``np`` on
+traced values is idiomatic and safe).
+
+False positives are silenced inline:
+``# staticcheck: disable=<rule>[,<rule>] -- <reason>``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .findings import (Finding, Report, apply_inline,
+                       parse_inline_suppressions, traced_directive_lines)
+
+__all__ = ["lint_file", "lint_paths", "env_reads_in_source",
+           "documented_env_vars", "ENV_PREFIX"]
+
+ENV_PREFIX = "MXNET_TPU_"
+
+#: call targets whose function-valued arguments become traced code
+_TRACERS = {
+    "jit", "pjit", "vjp", "grad", "value_and_grad", "vmap", "pmap",
+    "scan", "map", "cond", "while_loop", "fori_loop", "switch",
+    "checkpoint", "remat", "shard_map", "custom_vjp", "custom_jvp",
+    "eval_shape", "make_jaxpr",
+}
+
+#: attribute accesses that *untaint* (static shape/metadata math)
+_META_ATTRS = {"shape", "dtype", "ndim", "size", "aval", "sharding",
+               "itemsize", "nbytes"}
+
+#: method calls on a traced value that force a host sync
+_SYNC_METHODS = {"asnumpy", "asscalar", "item", "tolist", "__float__"}
+
+#: builtins that concretize a traced value
+_SYNC_BUILTINS = {"float", "int", "bool", "complex"}
+
+_NONDET_MODULES = {"time", "random", "datetime"}
+
+
+def _call_name(node: ast.Call) -> str:
+    """Rightmost name of the call target (``jax.lax.scan`` -> ``scan``)."""
+    if isinstance(node.func, ast.Attribute):
+        return node.func.attr
+    if isinstance(node.func, ast.Name):
+        return node.func.id
+    return ""
+
+
+def _dotted(node: ast.AST) -> str:
+    """``a.b.c`` for Name/Attribute chains, else ''."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+class _FileIndex(ast.NodeVisitor):
+    """One pass collecting defs, import aliases, and traced-entry calls."""
+
+    def __init__(self):
+        self.defs: Dict[str, List[ast.FunctionDef]] = {}
+        self.aliases: Dict[str, str] = {}   # local name -> module path
+        self.traced_names: Set[str] = set()
+        self.calls: List[ast.Call] = []
+
+    def visit_Import(self, node: ast.Import):
+        for a in node.names:
+            self.aliases[a.asname or a.name.split(".")[0]] = a.name
+
+    def visit_ImportFrom(self, node: ast.ImportFrom):
+        mod = node.module or ""
+        for a in node.names:
+            self.aliases[a.asname or a.name] = f"{mod}.{a.name}"
+
+    def visit_FunctionDef(self, node: ast.FunctionDef):
+        self.defs.setdefault(node.name, []).append(node)
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Call(self, node: ast.Call):
+        self.calls.append(node)
+        # jax.tree.map is host-side pytree plumbing, not jax.lax.map —
+        # its function argument is NOT traced
+        if _call_name(node) in _TRACERS and \
+                ".tree." not in f".{_dotted(node.func)}.":
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                for n in ast.walk(arg):
+                    if isinstance(n, ast.Name):
+                        self.traced_names.add(n.id)
+                    elif isinstance(n, ast.Attribute):
+                        # jax.jit(self._step) etc: rightmost attr name
+                        self.traced_names.add(n.attr)
+        self.generic_visit(node)
+
+
+def _is_traced_def(fn: ast.FunctionDef, index: _FileIndex,
+                   traced_lines: Sequence[int]) -> bool:
+    if fn.name in index.traced_names:
+        return True
+    for dec in fn.decorator_list:
+        d = _dotted(dec if not isinstance(dec, ast.Call) else dec.func)
+        if d.split(".")[-1] in _TRACERS:
+            return True
+    span = range(fn.lineno,
+                 (fn.body[0].lineno if fn.body else fn.lineno) + 1)
+    return any(line in span for line in traced_lines)
+
+
+def _module_of(name: str, index: _FileIndex) -> str:
+    """Resolve a local alias to its module path root (np -> numpy)."""
+    return index.aliases.get(name, name)
+
+
+class _TaintLinter:
+    """Walk one traced function body with parameter taint."""
+
+    def __init__(self, fn: ast.FunctionDef, index: _FileIndex,
+                 path: str, report: Report):
+        self.fn = fn
+        self.index = index
+        self.path = path
+        self.report = report
+        args = fn.args
+        names = [a.arg for a in (args.posonlyargs + args.args
+                                 + args.kwonlyargs)]
+        if args.vararg:
+            names.append(args.vararg.arg)
+        if args.kwarg:
+            names.append(args.kwarg.arg)
+        self.tainted: Set[str] = {n for n in names if n != "self"}
+
+    # -- taint of an expression ----------------------------------------
+
+    def _expr_tainted(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self.tainted
+        if isinstance(node, ast.Attribute):
+            if node.attr in _META_ATTRS:
+                return False       # shape/dtype math is static
+            return self._expr_tainted(node.value)
+        if isinstance(node, ast.Call):
+            fname = _call_name(node)
+            if fname in {"len", "range", "enumerate", "zip", "type",
+                         "isinstance", "getattr", "hasattr", "id"}:
+                return False
+            if isinstance(node.func, ast.Attribute) and \
+                    self._expr_tainted(node.func.value):
+                return True            # (g * g).sum() is still traced
+            return any(self._expr_tainted(a) for a in node.args) or \
+                any(self._expr_tainted(kw.value) for kw in node.keywords)
+        if isinstance(node, (ast.BinOp,)):
+            return self._expr_tainted(node.left) or \
+                self._expr_tainted(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self._expr_tainted(node.operand)
+        if isinstance(node, ast.Compare):
+            return self._expr_tainted(node.left) or \
+                any(self._expr_tainted(c) for c in node.comparators)
+        if isinstance(node, ast.Subscript):
+            return self._expr_tainted(node.value)
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return any(self._expr_tainted(e) for e in node.elts)
+        if isinstance(node, ast.Dict):
+            return any(self._expr_tainted(v) for v in node.values
+                       if v is not None)
+        if isinstance(node, ast.IfExp):
+            return (self._expr_tainted(node.body)
+                    or self._expr_tainted(node.orelse))
+        if isinstance(node, ast.Starred):
+            return self._expr_tainted(node.value)
+        return False
+
+    # -- walk ----------------------------------------------------------
+
+    def run(self):
+        for stmt in self.fn.body:
+            self._stmt(stmt)
+
+    def _stmt(self, stmt: ast.stmt):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return  # nested defs are linted as their own traced regions
+        if isinstance(stmt, ast.Assign):
+            taint = self._expr_tainted(stmt.value)
+            self._scan_expr(stmt.value)
+            for tgt in stmt.targets:
+                self._bind(tgt, taint)
+            return
+        if isinstance(stmt, ast.AugAssign):
+            self._scan_expr(stmt.value)
+            if self._expr_tainted(stmt.value) and \
+                    isinstance(stmt.target, ast.Name):
+                self.tainted.add(stmt.target.id)
+            return
+        if isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            self._scan_expr(stmt.value)
+            if stmt.target is not None:
+                self._bind(stmt.target, self._expr_tainted(stmt.value))
+            return
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.stmt):
+                self._stmt(child)
+            elif isinstance(child, ast.expr):
+                self._scan_expr(child)
+
+    def _bind(self, tgt: ast.AST, taint: bool):
+        if isinstance(tgt, ast.Name):
+            if taint:
+                self.tainted.add(tgt.id)
+            else:
+                self.tainted.discard(tgt.id)
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            for e in tgt.elts:
+                self._bind(e, taint)
+
+    def _scan_expr(self, node: ast.AST):
+        for n in ast.walk(node):
+            if isinstance(n, ast.Call):
+                self._check_call(n)
+
+    def _check_call(self, node: ast.Call):
+        fname = _call_name(node)
+        # .asnumpy()/.item()/... on a traced value
+        if isinstance(node.func, ast.Attribute) and \
+                fname in _SYNC_METHODS and \
+                self._expr_tainted(node.func.value):
+            self._add("source.host-sync", node,
+                      f"`.{fname}()` on a traced value inside traced "
+                      f"function `{self.fn.name}` forces a host sync / "
+                      "trace error")
+            return
+        # float(x)/int(x) on a traced value
+        if isinstance(node.func, ast.Name) and \
+                node.func.id in _SYNC_BUILTINS and node.args and \
+                self._expr_tainted(node.args[0]):
+            self._add("source.host-sync", node,
+                      f"`{node.func.id}(...)` concretizes a traced value "
+                      f"inside traced function `{self.fn.name}`")
+            return
+        root = _dotted(node.func).split(".")[0] if _dotted(node.func) \
+            else ""
+        mod = _module_of(root, self.index) if root else ""
+        # np.* applied to traced data (shape math was untainted above)
+        if mod.startswith("numpy") and "random" not in _dotted(node.func):
+            if any(self._expr_tainted(a) for a in node.args) or any(
+                    self._expr_tainted(kw.value) for kw in node.keywords):
+                self._add("source.host-sync", node,
+                          f"`{_dotted(node.func)}(...)` applied to a "
+                          f"traced value inside `{self.fn.name}` — use "
+                          "jnp, or hoist to trace time")
+            return
+        # nondeterminism baked into the trace
+        dotted = _dotted(node.func)
+        if mod.split(".")[0] in _NONDET_MODULES or \
+                (mod.startswith("numpy") and ".random." in f".{dotted}."):
+            self._add("source.nondet", node,
+                      f"`{dotted}(...)` inside traced function "
+                      f"`{self.fn.name}` bakes a trace-time value into "
+                      "the program (use the threaded rng / jax.random)")
+
+    def _add(self, rule: str, node: ast.AST, message: str):
+        self.report.add(Finding(rule, message, path=self.path,
+                                line=getattr(node, "lineno", 0)))
+
+
+# ----------------------------------------------------------------------
+# Env-var rules
+# ----------------------------------------------------------------------
+
+_ENV_NAME_RE = re.compile(r"\b(MXNET_TPU_[A-Z0-9_]+)\b")
+
+
+def _env_call_varname(node: ast.Call, consts: Dict[str, str]
+                      ) -> Optional[str]:
+    """Variable name read by an ``os.environ.get``/``os.getenv`` call, or
+    by a local wrapper whose name mentions ``env`` (``_env_flag(...)``,
+    ``_env_float(...)``) with a literal first argument."""
+    d = _dotted(node.func)
+    direct = d.endswith("environ.get") or d.endswith("getenv")
+    wrapper = bool(re.search(r"env", _call_name(node), re.IGNORECASE))
+    if not (direct or wrapper) or not node.args:
+        return None
+    a = node.args[0]
+    var: Optional[str] = None
+    if isinstance(a, ast.Constant) and isinstance(a.value, str):
+        var = a.value
+    elif isinstance(a, ast.Name):
+        var = consts.get(a.id)
+    if var is not None and not direct and not var.startswith(ENV_PREFIX):
+        return None                    # wrapper heuristic: prefix only
+    return var
+
+
+def env_reads_in_source(src: str, tree: Optional[ast.AST] = None
+                        ) -> List[Tuple[str, int]]:
+    """All ``MXNET_TPU_*`` env names read in one file: ``environ.get``/
+    ``getenv`` calls, ``environ[...]`` subscripts, and ``in os.environ``
+    tests — with module-level string constants resolved."""
+    tree = tree if tree is not None else ast.parse(src)
+    consts: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                isinstance(node.value, ast.Constant) and \
+                isinstance(node.value.value, str):
+            consts[node.targets[0].id] = node.value.value
+    out: List[Tuple[str, int]] = []
+
+    def name_of(expr: ast.AST) -> Optional[str]:
+        if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+            return expr.value
+        if isinstance(expr, ast.Name):
+            return consts.get(expr.id)
+        return None
+
+    for node in ast.walk(tree):
+        var: Optional[str] = None
+        if isinstance(node, ast.Call):
+            var = _env_call_varname(node, consts)
+        elif isinstance(node, ast.Subscript) and \
+                _dotted(node.value).endswith("environ"):
+            var = name_of(node.slice)
+        elif isinstance(node, ast.Compare) and len(node.ops) == 1 and \
+                isinstance(node.ops[0], (ast.In, ast.NotIn)) and \
+                _dotted(node.comparators[0]).endswith("environ"):
+            var = name_of(node.left)
+        if var and var.startswith(ENV_PREFIX):
+            out.append((var, getattr(node, "lineno", 0)))
+    return out
+
+
+def documented_env_vars(docs_text: str) -> Set[str]:
+    return set(_ENV_NAME_RE.findall(docs_text))
+
+
+# ----------------------------------------------------------------------
+# Donated-buffer mutation rule
+# ----------------------------------------------------------------------
+
+def _lint_donated_mutation(fn: ast.FunctionDef, path: str,
+                           report: Report) -> None:
+    """Within one function body (statement order, control flow ignored):
+    after ``x.mark_donated(...)`` or passing ``x`` at a donated position
+    of a jit built in this body with ``donate_argnums``, a later read of
+    ``x`` is flagged.  Rebinding ``x`` clears it."""
+    donated: Dict[str, int] = {}       # dotted name -> donation line
+    donating_jits: Dict[str, Set[int]] = {}
+
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Call) and \
+                _call_name(node.value) == "jit":
+            for kw in node.value.keywords:
+                if kw.arg == "donate_argnums":
+                    idxs = {c.value for c in ast.walk(kw.value)
+                            if isinstance(c, ast.Constant)
+                            and isinstance(c.value, int)}
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            donating_jits[tgt.id] = idxs
+
+    class _Walk(ast.NodeVisitor):
+        def visit_FunctionDef(self, node: ast.FunctionDef):
+            if node is fn:          # nested defs are walked on their own
+                self.generic_visit(node)
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_Call(self, call: ast.Call):
+            if isinstance(call.func, ast.Attribute) and \
+                    call.func.attr == "mark_donated":
+                name = _dotted(call.func.value)
+                if name:
+                    donated[name] = call.lineno
+            fname = call.func.id if isinstance(call.func, ast.Name) else ""
+            if fname in donating_jits:
+                for i in donating_jits[fname]:
+                    if i < len(call.args):
+                        name = _dotted(call.args[i])
+                        if name:
+                            donated[name] = call.lineno
+            self.generic_visit(call)
+
+        def visit_Assign(self, node: ast.Assign):
+            self.visit(node.value)
+            for tgt in node.targets:
+                name = _dotted(tgt)
+                if name in donated:
+                    del donated[name]   # rebound: a fresh buffer
+
+        def visit_Name(self, node: ast.Name):
+            self._check(node)
+
+        def visit_Attribute(self, node: ast.Attribute):
+            self._check(node)
+            self.generic_visit(node)   # reach the inner Name/chain
+
+        def _check(self, node: ast.AST):
+            if not isinstance(getattr(node, "ctx", None), ast.Load):
+                return
+            name = _dotted(node)
+            at = donated.get(name)
+            if at is not None and node.lineno > at:
+                report.add(Finding(
+                    "source.donated-mutation",
+                    f"`{name}` is read after being donated at line {at} "
+                    "— the buffer no longer exists",
+                    path=path, line=node.lineno,
+                    details={"donated_at": at}))
+                del donated[name]      # one finding per donation site
+
+    _Walk().visit(fn)
+
+
+# ----------------------------------------------------------------------
+# File / repo entry points
+# ----------------------------------------------------------------------
+
+def lint_file(path: str, src: Optional[str] = None,
+              rel: Optional[str] = None,
+              report: Optional[Report] = None) -> Report:
+    """Lint one Python file (traced-region + donation rules; env rules
+    are repo-level, see :func:`lint_paths`)."""
+    report = report if report is not None else Report(mode="lint")
+    if src is None:
+        with open(path, "r", encoding="utf-8") as f:
+            src = f.read()
+    rel = rel or path
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        report.add(Finding("source.parse-error",
+                           f"file does not parse: {e}", path=rel,
+                           line=e.lineno or 0, severity="error"))
+        return report
+    index = _FileIndex()
+    index.visit(tree)
+    traced_lines = traced_directive_lines(src)
+
+    start = len(report.findings)
+    for defs in index.defs.values():
+        for fn in defs:
+            if _is_traced_def(fn, index, traced_lines):
+                _TaintLinter(fn, index, rel, report).run()
+            _lint_donated_mutation(fn, rel, report)
+    apply_inline(report.findings[start:], parse_inline_suppressions(src))
+    return report
+
+
+def _iter_py(root: str, subdir: str) -> Iterable[str]:
+    base = os.path.join(root, subdir)
+    for dirpath, dirnames, filenames in os.walk(base):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)
+
+
+def lint_paths(root: str, paths: Optional[Sequence[str]] = None,
+               docs_path: Optional[str] = None,
+               report: Optional[Report] = None) -> Report:
+    """Lint a repo tree: per-file rules over every ``.py`` under
+    ``mxnet_tpu/`` (or explicit ``paths``) plus the two repo-level
+    env-var drift rules against ``docs/env_vars.md``."""
+    report = report if report is not None else Report(mode="lint")
+    if paths is None:
+        paths = list(_iter_py(root, "mxnet_tpu"))
+    docs_path = docs_path or os.path.join(root, "docs", "env_vars.md")
+
+    # env reads are scanned wider than the lint itself: tests/ and tools/
+    # legitimately read documented vars (MXNET_TPU_TESTS, ...), and a var
+    # only they read must not register as stale
+    env_scan = list(paths)
+    for extra in ("tests", "tools"):
+        env_scan.extend(p for p in _iter_py(root, extra)
+                        if p not in set(paths))
+
+    env_reads: Dict[str, Tuple[str, int]] = {}
+    lint_set = set(paths)
+    for path in env_scan:
+        rel = os.path.relpath(path, root)
+        with open(path, "r", encoding="utf-8") as f:
+            src = f.read()
+        if path in lint_set:
+            lint_file(path, src=src, rel=rel, report=report)
+        try:
+            tree = ast.parse(src)
+        except SyntaxError:
+            continue
+        supp = parse_inline_suppressions(src)
+        for var, line in env_reads_in_source(src, tree):
+            if path in lint_set:
+                env_reads.setdefault(var, (rel, line))
+            else:
+                env_reads.setdefault(var, ("", 0))  # read outside lint set
+            hit = supp.get(line)
+            if hit and any(p in ("source.env-undocumented", "source.*")
+                           for p in hit[0]):
+                env_reads[var] = ("", 0)  # suppressed at the read site
+
+    documented: Set[str] = set()
+    if os.path.exists(docs_path):
+        with open(docs_path, "r", encoding="utf-8") as f:
+            documented = documented_env_vars(f.read())
+    start = len(report.findings)
+    for var, (rel, line) in sorted(env_reads.items()):
+        if var not in documented and rel:
+            report.add(Finding(
+                "source.env-undocumented",
+                f"env var `{var}` is read here but not documented in "
+                f"docs/env_vars.md", path=rel, line=line,
+                details={"var": var}))
+    for var in sorted(documented - set(env_reads)):
+        report.add(Finding(
+            "source.env-stale",
+            f"docs/env_vars.md documents `{var}` but no code under "
+            "mxnet_tpu/ reads it",
+            path=os.path.relpath(docs_path, root),
+            details={"var": var}))
+    report.metrics["lint"] = {
+        "files": len(list(paths)),
+        "env_reads": sorted(env_reads),
+        "env_documented": sorted(documented),
+    }
+    return report

@@ -381,6 +381,47 @@ _global: Dict[str, Any] = {"cache": None}
 _glock = threading.Lock()
 
 
+# ---------------------------------------------------------------------------
+# Lowering observers
+# ---------------------------------------------------------------------------
+#
+# The static auditor (mxnet_tpu.analysis) taps the compile path here:
+# every program the framework traces on its way INTO the cache is
+# offered to registered observers as a ``jax.stages.Traced``, so
+# ``analysis.audit_on_compile()`` inspects exactly what gets compiled —
+# no second trace, no drift between the audited and the shipped
+# program.  Observers fire on cache misses only (a hit dispatches a
+# stored executable; there is no fresh lowering to look at).
+
+_lowering_observers: List[Callable[[str, Any], None]] = []
+
+
+def add_lowering_observer(fn: Callable[[str, Any], None]) -> None:
+    """Register ``fn(label, traced)`` to be called for every program
+    traced for compilation while registered."""
+    with _glock:
+        if fn not in _lowering_observers:
+            _lowering_observers.append(fn)
+
+
+def remove_lowering_observer(fn: Callable[[str, Any], None]) -> None:
+    with _glock:
+        if fn in _lowering_observers:
+            _lowering_observers.remove(fn)
+
+
+def notify_lowering(label: str, traced: Any) -> None:
+    """Offer a freshly traced program to observers.  Observer errors are
+    logged, never raised — an analysis bug must not break compilation."""
+    with _glock:
+        observers = list(_lowering_observers)
+    for fn in observers:
+        try:
+            fn(label, traced)
+        except Exception:
+            _log.exception("lowering observer %r failed on %r", fn, label)
+
+
 def enable_persistent_cache(cache_dir: str) -> None:
     """Point jax's own HLO-keyed compilation cache at
     ``<cache_dir>/xla`` and drop the size/time thresholds so every

@@ -593,6 +593,7 @@ class ShardedTrainer:
         # persistent-cache executable stays valid across runs that resume
         # with different keys.
         from .. import random as _random
+        from ..analysis.program import mark_grads as _mark_grads
         if getattr(self, "_base_key", None) is None:
             self._set_base_key(_random._next_key())
 
@@ -692,6 +693,11 @@ class ShardedTrainer:
                     # explicit-comm path: guard stat came fused off the
                     # reduced flat buckets (no extra pass over grads)
                     sq = res[3]
+
+            # identity-tag the grads for the static auditor's HBM-pass
+            # counter: mxtpu_tag lowers to nothing, so HLO, executables
+            # and compile-cache keys are unchanged (analysis/program.py)
+            grads = _mark_grads(grads)
 
             ok = None
             if resil is not None:
@@ -899,6 +905,98 @@ class ShardedTrainer:
         return cc.program_key(self._graph_fp, in_avals, donate=donate,
                               mesh=self.mesh, extra=extra)
 
+    def _program_avals(self):
+        """Shape/dtype/sharding snapshots of the non-batch program
+        arguments ``(params, aux, opt, key, guard state)``, taken on the
+        calling thread — no live buffers, so background lowering or a
+        later audit never touches arrays a concurrent step may donate."""
+        sds = jax.ShapeDtypeStruct
+        p_avals = {n: sds(v.shape, v.dtype, sharding=v.sharding)
+                   for n, v in self._params.items()}
+        a_avals = {n: sds(v.shape, v.dtype, sharding=v.sharding)
+                   for n, v in self._aux.items()}
+        o_avals = {n: jax.tree.map(
+            lambda l: sds(l.shape, l.dtype, sharding=l.sharding),
+            self._opt_state[n]) for n in self._param_names}
+        bkey = self._base_key
+        k_aval = sds(bkey.shape, bkey.dtype,
+                     sharding=getattr(bkey, "sharding", None))
+        g_avals = None
+        if self._guard_state is not None:
+            g_avals = {k: sds(v.shape, v.dtype, sharding=v.sharding)
+                       for k, v in self._guard_state.items()}
+        return p_avals, a_avals, o_avals, k_aval, g_avals
+
+    def _norm_batch_spec(self, spec):
+        """One batch_spec dict -> ``{input: ShapeDtypeStruct}`` with the
+        data-axis batch sharding applied."""
+        sds = jax.ShapeDtypeStruct
+        bsh = (batch_sharding(self.mesh, self.data_axis)
+               if self.data_axis is not None else replicated(self.mesh))
+        out = {}
+        for n in self._input_names:
+            if n not in spec:
+                raise MXNetError(f"batch_spec missing input {n!r}")
+            v = spec[n]
+            if isinstance(v, jax.ShapeDtypeStruct):
+                shape, dtype = tuple(v.shape), v.dtype
+            elif isinstance(v, tuple) and len(v) == 2 \
+                    and isinstance(v[0], (tuple, list)):
+                shape, dtype = tuple(v[0]), jnp.dtype(v[1])
+            elif hasattr(v, "shape") and hasattr(v, "dtype"):
+                shape, dtype = tuple(v.shape), jnp.dtype(v.dtype)
+            else:
+                shape, dtype = tuple(v), jnp.float32
+            out[n] = sds(shape, dtype, sharding=bsh)
+        return out
+
+    def _program_call_args(self, kind: str, b_avals, avals=None):
+        """``(jit_fn, in_args)`` for one step program at the given batch
+        avals — the single definition of each program's call signature,
+        shared by AOT compilation and the static auditor.
+
+        lr/t are concrete python scalars: lowering abstracts them to the
+        same weak-typed avals the real dispatch produces, so a compiled
+        program accepts any python float/int."""
+        if avals is None:
+            avals = self._program_avals()
+        p_avals, a_avals, o_avals, k_aval, g_avals = avals
+        sds = jax.ShapeDtypeStruct
+        if kind == "train":
+            jit_fn = self._train_step
+            in_args = (p_avals, a_avals, o_avals, b_avals, 0.5, 1,
+                       k_aval)
+            if g_avals is not None:
+                in_args += (g_avals,)
+        elif kind == "train_acc":
+            carry = sds((), jnp.int32, sharding=replicated(self.mesh))
+            jit_fn = self._train_step_acc
+            in_args = (p_avals, a_avals, o_avals, b_avals, 0.5, 1,
+                       carry, k_aval)
+            if g_avals is not None:
+                in_args += (g_avals,)
+        elif kind == "eval":
+            jit_fn = self._eval_step
+            in_args = (p_avals, a_avals, b_avals, 1, k_aval)
+        else:
+            raise MXNetError(f"unknown program kind {kind!r} "
+                             "(train/train_acc/eval)")
+        return jit_fn, in_args
+
+    def trace_program(self, kind: str = "train", batch_spec=None):
+        """Trace one step program to a ``jax.stages.Traced`` for static
+        analysis (:func:`mxnet_tpu.analysis.audit_trainer`) without
+        executing or caching anything.  Returns ``(traced, in_args)``;
+        ``traced.jaxpr`` is the closed jaxpr, ``traced.lower()`` the
+        lowering the auditor inspects for donation/sharding."""
+        if not self._bound:
+            raise MXNetError("call bind() before trace_program()")
+        spec = batch_spec if batch_spec is not None else self._input_shapes
+        b_avals = self._norm_batch_spec(spec)
+        jit_fn, in_args = self._program_call_args(kind, b_avals)
+        with default_mesh(self.mesh), self._precision_scope():
+            return jit_fn.trace(*in_args), in_args
+
     def compile(self, batch_spec=None, programs: Sequence[str] = ("train",),
                 background: bool = False):
         """Ahead-of-time compile the step programs for known batch shapes
@@ -927,83 +1025,31 @@ class ShardedTrainer:
         if not self._bound:
             raise MXNetError("call bind() before compile()")
         from .. import compile_cache as cc
-        sds = jax.ShapeDtypeStruct
         specs = batch_spec if batch_spec is not None else self._input_shapes
         if isinstance(specs, dict):
             specs = [specs]
 
-        # aval snapshots taken on THIS thread: shape/dtype/sharding only,
-        # no live buffers, so background lowering never touches arrays a
-        # concurrent step may donate
-        p_avals = {n: sds(v.shape, v.dtype, sharding=v.sharding)
-                   for n, v in self._params.items()}
-        a_avals = {n: sds(v.shape, v.dtype, sharding=v.sharding)
-                   for n, v in self._aux.items()}
-        o_avals = {n: jax.tree.map(
-            lambda l: sds(l.shape, l.dtype, sharding=l.sharding),
-            self._opt_state[n]) for n in self._param_names}
-        bkey = self._base_key
-        k_aval = sds(bkey.shape, bkey.dtype,
-                     sharding=getattr(bkey, "sharding", None))
-        g_avals = None
-        if self._guard_state is not None:
-            g_avals = {k: sds(v.shape, v.dtype, sharding=v.sharding)
-                       for k, v in self._guard_state.items()}
-        bsh = (batch_sharding(self.mesh, self.data_axis)
-               if self.data_axis is not None else replicated(self.mesh))
-
-        def norm_spec(spec):
-            out = {}
-            for n in self._input_names:
-                if n not in spec:
-                    raise MXNetError(f"batch_spec missing input {n!r}")
-                v = spec[n]
-                if isinstance(v, jax.ShapeDtypeStruct):
-                    shape, dtype = tuple(v.shape), v.dtype
-                elif isinstance(v, tuple) and len(v) == 2 \
-                        and isinstance(v[0], (tuple, list)):
-                    shape, dtype = tuple(v[0]), jnp.dtype(v[1])
-                elif hasattr(v, "shape") and hasattr(v, "dtype"):
-                    shape, dtype = tuple(v.shape), jnp.dtype(v.dtype)
-                else:
-                    shape, dtype = tuple(v), jnp.float32
-                out[n] = sds(shape, dtype, sharding=bsh)
-            return out
+        # aval snapshots taken on THIS thread (see _program_avals)
+        avals = self._program_avals()
 
         work = []
         for spec in specs:
-            b_avals = norm_spec(spec)
+            b_avals = self._norm_batch_spec(spec)
             for kind in programs:
                 work.append((kind, b_avals))
 
         def compile_one(kind, b_avals):
-            # lr/t are concrete python scalars: lowering abstracts them to
-            # the same weak-typed avals the real dispatch produces, so the
-            # compiled program accepts any python float/int
-            if kind == "train":
-                jit_fn = self._train_step
-                in_args = (p_avals, a_avals, o_avals, b_avals, 0.5, 1,
-                           k_aval)
-                if g_avals is not None:
-                    in_args += (g_avals,)
-            elif kind == "train_acc":
-                carry = sds((), jnp.int32, sharding=replicated(self.mesh))
-                jit_fn = self._train_step_acc
-                in_args = (p_avals, a_avals, o_avals, b_avals, 0.5, 1,
-                           carry, k_aval)
-                if g_avals is not None:
-                    in_args += (g_avals,)
-            elif kind == "eval":
-                jit_fn = self._eval_step
-                in_args = (p_avals, a_avals, b_avals, 1, k_aval)
-            else:
-                raise MXNetError(f"unknown program kind {kind!r} "
-                                 "(train/train_acc/eval)")
+            jit_fn, in_args = self._program_call_args(kind, b_avals,
+                                                      avals=avals)
             key = self._program_key(kind, in_args)
 
             def build():
                 with default_mesh(self.mesh), self._precision_scope():
-                    return jit_fn.lower(*in_args).compile()
+                    traced = jit_fn.trace(*in_args)
+                    # offer the fresh trace to registered observers
+                    # (analysis.audit_on_compile) before committing it
+                    cc.notify_lowering(f"trainer.{kind}", traced)
+                    return traced.lower().compile()
 
             compiled, info = cc.get_cache().get_or_compile(
                 key, build, label=f"trainer.{kind}")

@@ -147,6 +147,37 @@ def format_compile_report(title: str = "compile") -> str:
 
 
 # ---------------------------------------------------------------------------
+# Static-audit events (mxnet_tpu.analysis)
+# ---------------------------------------------------------------------------
+#
+# Each program the static auditor walks lands here (label, finding
+# count, wall seconds), so "why is the staticcheck gate slow" and "which
+# program produced findings" are answerable from the same process-wide
+# event log as compiles.
+
+_audit_events: List[Dict[str, object]] = []
+
+
+def record_audit(program: str, findings: int, seconds: float) -> None:
+    """Record one audited program (called by ``analysis.audit_traced``)."""
+    with _compile_lock:
+        _audit_events.append({"program": str(program),
+                              "findings": int(findings),
+                              "seconds": float(seconds)})
+
+
+def audit_events() -> List[Dict[str, object]]:
+    """Snapshot of recorded audit events (oldest first)."""
+    with _compile_lock:
+        return [dict(e) for e in _audit_events]
+
+
+def reset_audit_events() -> None:
+    with _compile_lock:
+        _audit_events.clear()
+
+
+# ---------------------------------------------------------------------------
 # Event counters
 # ---------------------------------------------------------------------------
 #

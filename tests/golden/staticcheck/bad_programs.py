@@ -1,0 +1,67 @@
+"""Seeded-bad lowered programs for the staticcheck gate corpus.
+
+Imported (via file path) by ``tools/staticcheck.py gate`` and
+``tests/test_staticcheck.py``.  Each builder traces a tiny program with
+one deliberate hazard and returns ``(traced, audit_kwargs)`` for
+:func:`mxnet_tpu.analysis.audit_traced`; ``PROGRAMS`` maps builder name
+to the rules that MUST fire on it (empty list = negative control).
+"""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+_SDS = jax.ShapeDtypeStruct
+
+
+def carry_widen():
+    """The PR 2 bug class: an int32 metric carry accumulated with an
+    unpinned bool-sum widens to int64 under the package's enable_x64 —
+    the next step call sees a new input dtype and re-traces forever."""
+    def step(carry, pred, label):
+        hits = jnp.sum(pred.astype(jnp.int32) == label.astype(jnp.int32))
+        return carry + hits
+    tr = jax.jit(step).trace(_SDS((), jnp.int32), _SDS((16,), jnp.float32),
+                             _SDS((16,), jnp.float32))
+    return tr, {"carry_pairs": [(0, 0, "metric carry")]}
+
+
+def host_transfer():
+    def step(x):
+        y = jax.pure_callback(lambda a: np.tanh(a),
+                              _SDS((8,), jnp.float32), x)
+        return y * 2.0
+    return jax.jit(step).trace(_SDS((8,), jnp.float32)), {}
+
+
+def captured_const():
+    table = np.arange(65536, dtype=np.float32)    # 256 KiB baked in
+    def step(idx):
+        return jnp.take(jnp.asarray(table), idx)
+    return jax.jit(step).trace(_SDS((4,), jnp.int32)), {}
+
+
+def donation_miss():
+    def step(x):
+        # no output shares x's shape/dtype -> XLA cannot alias the
+        # donated buffer; it is freed + reallocated every call
+        return (x[:4] * 2.0).astype(jnp.bfloat16)
+    jf = jax.jit(step, donate_argnums=(0,))
+    return jf.trace(_SDS((8,), jnp.float32)), {"donate_flat": [0]}
+
+
+def clean():
+    """Negative control: the gate fails if anything fires here."""
+    def step(x, y):
+        return x @ y
+    return jax.jit(step).trace(_SDS((4, 4), jnp.float32),
+                               _SDS((4, 4), jnp.float32)), {}
+
+
+PROGRAMS = {
+    "carry_widen": (carry_widen, ["program.carry-widen", "program.widen"]),
+    "host_transfer": (host_transfer, ["program.host-transfer"]),
+    "captured_const": (captured_const, ["program.captured-const"]),
+    "donation_miss": (donation_miss, ["program.donation-miss"]),
+    "clean": (clean, []),
+}

@@ -1,0 +1,21 @@
+"""Seeded corpus: buffer reads after donation (source.donated-mutation).
+
+Lint-only — this module is never imported, it only has to parse.
+"""
+import jax
+
+
+def _apply(p, g):
+    return jax.tree.map(lambda a, b: a - 0.1 * b, p, g)
+
+
+def update(params, grads):
+    step = jax.jit(_apply, donate_argnums=(0,))
+    new = step(params, grads)
+    print(params)                               # BAD: source.donated-mutation
+    return new
+
+
+def reuse_after_mark(arr):
+    arr.mark_donated()
+    return arr.sum()                            # BAD: source.donated-mutation

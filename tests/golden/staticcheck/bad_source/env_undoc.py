@@ -1,0 +1,10 @@
+"""Seeded corpus: undocumented MXNET_TPU_* env reads
+(source.env-undocumented).  Lint-only — never imported.
+"""
+import os
+
+_FLAG = os.environ.get("MXNET_TPU_CORPUS_ONLY_KNOB", "0")  # BAD: env-undocumented
+
+
+def strict_mode():
+    return os.environ["MXNET_TPU_CORPUS_STRICT"] == "1"    # BAD: env-undocumented

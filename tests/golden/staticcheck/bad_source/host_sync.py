@@ -1,0 +1,34 @@
+"""Seeded corpus: host syncs on traced values (source.host-sync).
+
+Lint-only — this module is never imported, it only has to parse.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def loss_with_asnumpy(params, batch):
+    logits = params @ batch
+    host = logits.asnumpy()                     # BAD: source.host-sync
+    return jnp.mean(host)
+
+
+def scale_by_norm(g):
+    norm = float(jnp.sqrt((g * g).sum()))       # BAD: source.host-sync
+    return g / norm
+
+
+def apply_all(grads):
+    return jax.vmap(scale_by_norm)(grads)
+
+
+@jax.jit
+def np_on_traced(x):
+    return np.sum(x)                            # BAD: source.host-sync
+
+
+@jax.jit
+def ok_shape_math(x):
+    # negative control: np on .shape metadata is static and fine
+    return x.reshape((int(np.prod(x.shape)),))

@@ -1,0 +1,19 @@
+"""Seeded corpus: nondeterminism baked into traces (source.nondet).
+
+Lint-only — this module is never imported, it only has to parse.
+"""
+import time
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def stamp(x):
+    return x + time.time()                      # BAD: source.nondet
+
+
+@jax.jit
+def noisy(x):
+    noise = np.random.randn(4, 4)               # BAD: source.nondet
+    return x + noise

@@ -1,0 +1,40 @@
+#!/usr/bin/env bash
+# CI entry: static-analysis gate first (fast, ~10 s — catches program
+# hazards and repo drift before spending minutes on tests), then the
+# tier-1 pytest suite exactly as ROADMAP.md specifies it.
+#
+# Usage: tools/ci_check.sh [--gate-only|--tests-only]
+set -u -o pipefail
+
+REPO_ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+cd "$REPO_ROOT"
+
+# the acceptance platform: 8-virtual-device CPU mesh (a real TPU run
+# exports its own JAX_PLATFORMS and skips these defaults)
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+export XLA_FLAGS="${XLA_FLAGS:---xla_force_host_platform_device_count=8}"
+
+mode="${1:-all}"
+
+if [[ "$mode" != "--tests-only" ]]; then
+    echo "== staticcheck gate (tools/staticcheck.py, docs/static_analysis.md) =="
+    python tools/staticcheck.py gate
+    rc=$?
+    if [[ $rc -ne 0 ]]; then
+        echo "ci_check: staticcheck gate FAILED (rc=$rc)" >&2
+        exit "$rc"
+    fi
+fi
+
+if [[ "$mode" == "--gate-only" ]]; then
+    exit 0
+fi
+
+echo "== tier-1 tests (ROADMAP.md) =="
+rm -f /tmp/_t1.log
+timeout -k 10 870 python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider -p no:xdist \
+    -p no:randomly 2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)"
+exit "$rc"
