@@ -46,6 +46,10 @@ RULES: Dict[str, Tuple[str, str]] = {
         "error", "a carried value changes sharding across the step, or a "
         "scalar carry is not fully replicated — every call regathers or "
         "re-traces"),
+    "program.fused-update": (
+        "error", "a fused-update program breaks the single-pass HBM "
+        "contract: a grad bucket is traversed more than once "
+        "(reads/writes > 1) or the fused primitive/tags are missing"),
     "source.host-sync": (
         "error", ".asnumpy()/.asscalar()/float()/np.* applied to a traced "
         "value inside a jitted function (breaks tracing or silently "

@@ -92,6 +92,16 @@ FREE_PASS_PRIMS = frozenset({
     "mxtpu_tag",
 })
 
+#: opaque fused-kernel calls that stream each operand through VMEM once:
+#: counted as exactly 1 read + 1 write for every tagged operand they
+#: consume, with NO propagation to their outputs (the outputs are the
+#: updated weight/state buffers, not more traffic over the gradient).
+#: This is how the counter sees through ``pallas_call`` and the fused
+#: update primitive instead of miscounting them as ordinary eqns.
+STREAM_ONCE_PRIMS = frozenset({
+    "pallas_call", "mxtpu_fused_update",
+})
+
 _64BIT_KINDS = ("f", "i", "u", "c")
 
 
@@ -104,6 +114,7 @@ class AuditConfig:
     count_hbm: bool = True
     host_transfer_prims: frozenset = HOST_TRANSFER_PRIMS
     free_pass_prims: frozenset = FREE_PASS_PRIMS
+    stream_once_prims: frozenset = STREAM_ONCE_PRIMS
 
 
 def _is64(aval) -> bool:
@@ -424,6 +435,7 @@ def update_passes(closed, config: Optional[AuditConfig] = None
     """
     config = config or AuditConfig()
     free = config.free_pass_prims
+    stream_once = config.stream_once_prims
     roots: Dict[str, Tuple[int, ...]] = {}          # label -> shape
     derived: Dict[Any, Set[str]] = {}               # var -> labels
     reads: Dict[str, int] = {}
@@ -449,6 +461,13 @@ def update_passes(closed, config: Optional[AuditConfig] = None
         if eqn.primitive.name in free:
             for ov in eqn.outvars:
                 derived.setdefault(ov, set()).update(hit)
+            continue
+        if eqn.primitive.name in stream_once:
+            # fused kernel: one streaming pass over every bucket operand;
+            # outputs are new weight/state buffers, not derived grads
+            for label in hit:
+                reads[label] = reads.get(label, 0) + 1
+                writes[label] = writes.get(label, 0) + 1
             continue
         for label in hit:
             reads[label] = reads.get(label, 0) + 1
@@ -498,6 +517,51 @@ def bucket_passes(per_param: Dict[str, Dict[str, int]],
     return out
 
 
+def _fused_bucket_passes(per_label: Dict[str, Dict[str, int]],
+                         plan) -> List[Dict[str, Any]]:
+    """Bucket rows for a fused-update program: the trainer tags each flat
+    bucket ``gradbucket:<i>`` directly, so counts map 1:1 onto the
+    :class:`~mxnet_tpu.ops.fused_update.FusedPlan` buckets — no
+    per-param aggregation needed."""
+    out: List[Dict[str, Any]] = []
+    for i, segs in enumerate(plan.buckets):
+        c = per_label.get(f"gradbucket:{i}", {"reads": 0, "writes": 0})
+        out.append({
+            "index": i,
+            "dtype": "float32",
+            "bytes": sum(s1 - s0 for _, s0, s1 in segs) * 4,
+            "params": sorted({n for n, _, _ in segs}),
+            "reads": c["reads"],
+            "writes": c["writes"],
+        })
+    return out
+
+
+def _check_fused_update(per: Dict[str, Dict[str, int]], program: str,
+                        report: Report) -> None:
+    """The ``program.fused-update`` rule: a program audited with
+    ``expect_fused`` must tag its buckets and traverse each exactly
+    once (1 read / 1 write — the single-pass HBM contract)."""
+    labels = [l for l in per if l.startswith("gradbucket:")]
+    if not labels:
+        report.add(Finding(
+            "program.fused-update",
+            "expect_fused was set but no `gradbucket:<i>` tags exist in "
+            "the program — the fused update path is not in the trace",
+            program=program))
+        return
+    for l in sorted(labels):
+        c = per[l]
+        if c["reads"] > 1 or c["writes"] > 1:
+            report.add(Finding(
+                "program.fused-update",
+                f"fused bucket `{l}` is traversed {c['reads']} reads / "
+                f"{c['writes']} writes — the single-pass contract is "
+                "1R/1W, so an op outside the fused primitive is touching "
+                "the bucket",
+                program=program, details={"label": l, **c}))
+
+
 # ----------------------------------------------------------------------
 # Generic entry: audit one traced program
 # ----------------------------------------------------------------------
@@ -507,6 +571,7 @@ def audit_traced(traced, program: str,
                  never_donate: Optional[Dict[int, str]] = None,
                  carry_pairs: Optional[Sequence[Tuple[int, int, str]]] = None,
                  replicated_out: Optional[Sequence[Tuple[int, str]]] = None,
+                 expect_fused: bool = False,
                  config: Optional[AuditConfig] = None,
                  report: Optional[Report] = None) -> Report:
     """Run every program rule over one ``jax.stages.Traced``.
@@ -517,6 +582,9 @@ def audit_traced(traced, program: str,
     ``carry_pairs``: ``(in_flat_idx, out_flat_idx, name)`` carried state.
     ``replicated_out``: ``(out_flat_idx, name)`` scalar carries that must
     be fully replicated.
+    ``expect_fused``: assert the single-pass fused-update contract — the
+    program must contain ``gradbucket:<i>`` tags and traverse each
+    exactly once (``program.fused-update`` findings otherwise).
     """
     config = config or AuditConfig()
     report = report if report is not None else Report(mode="audit")
@@ -554,6 +622,8 @@ def audit_traced(traced, program: str,
         per = update_passes(closed, config)
         if per:
             metrics["hbm_passes"] = {"per_grad": per}
+        if expect_fused:
+            _check_fused_update(per, program, report)
     report.metrics[program] = metrics
     profiler.record_audit(program, len(report.findings) - n0,
                           time.perf_counter() - t0)
@@ -620,17 +690,24 @@ def audit_trainer(trainer, programs: Sequence[str] = ("train", "train_acc"),
                     carry_pairs.append(
                         (gs_in + j, out_after_heads + j, gnames[j]))
                     replicated_out.append((out_after_heads + j, gnames[j]))
+        fused_plan = (trainer._fused_plan
+                      if getattr(trainer, "_fused", False) else None)
         audit_traced(
             traced, label, donate_flat=donate_flat,
             carry_pairs=carry_pairs, replicated_out=replicated_out,
+            expect_fused=(fused_plan is not None
+                          and kind in ("train", "train_acc")),
             config=config, report=report)
         if config.count_hbm and kind in ("train", "train_acc"):
             per = report.metrics[label].get(
                 "hbm_passes", {}).get("per_grad")
             if per:
-                buckets = bucket_passes(
-                    per, trainer._params, trainer._param_names,
-                    trainer.grad_bucket_bytes)
+                if fused_plan is not None:
+                    buckets = _fused_bucket_passes(per, fused_plan)
+                else:
+                    buckets = bucket_passes(
+                        per, trainer._params, trainer._param_names,
+                        trainer.grad_bucket_bytes)
                 hbm = report.metrics[label]["hbm_passes"]
                 hbm["buckets"] = buckets
                 hbm["max_reads"] = max(

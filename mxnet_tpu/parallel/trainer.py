@@ -142,6 +142,7 @@ class ShardedTrainer:
                  grad_accum: int = 1,
                  grad_compression: Optional[str] = None,
                  grad_bucket_bytes: Optional[int] = None,
+                 fused_update: Optional[bool] = None,
                  guard: Optional[bool] = None,
                  clip_global_norm: Optional[float] = None,
                  loss_scale=None,
@@ -210,6 +211,18 @@ class ShardedTrainer:
         if grad_compression is not None and self.data_axis is None:
             raise MXNetError("grad_compression needs a data axis to "
                              "reduce over; this mesh has none")
+        # single-pass fused optimizer update (ops/fused_update.py): one
+        # primitive per flat grad bucket replaces the unfused jnp chain
+        # (loss-scale unscale x clip x guard gating x optimizer step),
+        # with optimizer state laid out bucket-aligned so grads, weights
+        # and moments stream through VMEM in lockstep.  None = auto (on
+        # for eligible configs; MXNET_TPU_FUSED_UPDATE=0 opts out);
+        # True raises at bind() if the config cannot fuse; False forces
+        # the unfused path.
+        self._fused_req = fused_update
+        self._fused = False
+        self._fused_kind: Optional[str] = None
+        self._fused_plan = None
         # step-level anomaly defense (resilience.py): a fused non-finite
         # guard gates the whole param/opt-state update with jnp.where (a
         # bad step leaves state bitwise-unchanged), dynamic loss scaling
@@ -397,15 +410,38 @@ class ShardedTrainer:
                 "%d TP-rule-sharded, %d replicated%s", len(dim_sharded),
                 len(flat), len(rule_sharded), len(left),
                 (" (" + ", ".join(left) + ")") if left else "")
-        opt_state = {}
+        self._num_update = opt.begin_num_update
+        self._lr_mult = {n: opt.lr_mult.get(n, 1.0)
+                         for n in self._param_names}
+        self._wd_mult = {}
         for n in self._param_names:
-            flat_len = self._zero_flat[n]
-            template = (jnp.zeros((flat_len,), params[n].dtype)
-                        if flat_len is not None else params[n])
-            opt_state[n] = jax.tree.map(
-                lambda z, _n=n: self._global_put(
-                    z, NamedSharding(self.mesh, self._zero_specs[_n])),
-                opt.state_zeros_like(template))
+            if n in opt.wd_mult:
+                self._wd_mult[n] = opt.wd_mult[n]
+            elif n.endswith(("_gamma", "_beta", "_bias")):
+                self._wd_mult[n] = 0.0
+            else:
+                self._wd_mult[n] = 1.0
+        self._setup_fused(shape_of, params)
+        opt_state = {}
+        if self._fused:
+            # bucket-aligned optimizer state: moments live as replicated
+            # flat f32 buffers in the SAME streaming order as the reduced
+            # grad buckets, keyed "fused:<i>" (checkpoints namespace them
+            # opt:fused:<i>:<leaf> like any other opt-state entry)
+            rep = replicated(self.mesh)
+            for i, blen in enumerate(self._fused_plan.bucket_sizes):
+                opt_state[f"fused:{i}"] = jax.tree.map(
+                    lambda z: self._global_put(z, rep),
+                    opt.state_zeros_like(jnp.zeros((blen,), jnp.float32)))
+        else:
+            for n in self._param_names:
+                flat_len = self._zero_flat[n]
+                template = (jnp.zeros((flat_len,), params[n].dtype)
+                            if flat_len is not None else params[n])
+                opt_state[n] = jax.tree.map(
+                    lambda z, _n=n: self._global_put(
+                        z, NamedSharding(self.mesh, self._zero_specs[_n])),
+                    opt.state_zeros_like(template))
 
         self._params, self._aux, self._opt_state = params, aux, opt_state
         if self._resil is not None:
@@ -417,16 +453,6 @@ class ShardedTrainer:
                 k: self._global_put(v, rep)
                 for k, v in resilience.init_state(self._resil).items()}
             self._resil_base = {k: 0 for k in resilience.WINDOW_KEYS}
-        self._num_update = opt.begin_num_update
-        self._lr_mult = {n: opt.lr_mult.get(n, 1.0) for n in self._param_names}
-        self._wd_mult = {}
-        for n in self._param_names:
-            if n in opt.wd_mult:
-                self._wd_mult[n] = opt.wd_mult[n]
-            elif n.endswith(("_gamma", "_beta", "_bias")):
-                self._wd_mult[n] = 0.0
-            else:
-                self._wd_mult[n] = 1.0
         if self.grad_compression is not None:
             sharded = [n for n in self._param_names
                        if any(ax is not None
@@ -467,6 +493,54 @@ class ShardedTrainer:
         padded = -(-numel // n) * n  # ceil to a multiple of the data axis
         return P(self.data_axis), padded
 
+    def _setup_fused(self, shape_of, params) -> None:
+        """Decide whether this bind runs the single-pass fused update
+        (ops/fused_update.py) and build the bucket plan if so.  The gate
+        is conservative: any configuration the kernel cannot express
+        bitwise (per-param multipliers, sharded state, non-f32 masters)
+        silently falls back to the unfused path — unless the user forced
+        ``fused_update=True``, which makes ineligibility an error."""
+        from ..ops import fused_update as fu
+        self._fused = False
+        self._fused_kind = None
+        self._fused_plan = None
+        req = self._fused_req
+        if req is False or (req is None and not fu.fused_enabled()):
+            return
+        kind = fu.fused_kind(self.optimizer)
+        why = []
+        if not self._param_names:
+            why.append("no parameters")
+        if kind is None:
+            why.append(f"optimizer {type(self.optimizer).__name__} has "
+                       "no fused twin")
+        if self.shard_optimizer:
+            why.append("shard_optimizer (ZeRO state layout)")
+        if any(ax is not None for n in self._param_names
+               for ax in self.rules.spec_for(n)):
+            why.append("tensor-parallel param sharding")
+        if any(params[n].dtype != jnp.float32 for n in self._param_names):
+            why.append("non-f32 master params")
+        if any(int(np.prod(shape_of[n], dtype=np.int64)) == 0
+               for n in self._param_names):
+            why.append("zero-size params")
+        if len({float(v) for v in self._lr_mult.values()}) > 1:
+            why.append("per-param lr_mult")
+        if len({float(self.optimizer.wd * v)
+                for v in self._wd_mult.values()}) > 1:
+            why.append("per-param effective wd")
+        if why:
+            if req:
+                raise MXNetError("fused_update=True but this "
+                                 "configuration cannot fuse: "
+                                 + "; ".join(why))
+            self.logger.debug("fused update off: %s", "; ".join(why))
+            return
+        self._fused_kind = kind
+        self._fused_plan = fu.build_plan(self._param_names, shape_of,
+                                         self.grad_bucket_bytes)
+        self._fused = True
+
     def _zero_spec(self, name: str, shape: Tuple[int, ...]) -> P:
         return self._zero_plan(name, shape)[0]
 
@@ -479,7 +553,8 @@ class ShardedTrainer:
                 total += int(np.prod(shard)) * leaf.dtype.itemsize
         return total
 
-    def _explicit_comm_grads(self, base, resil: bool = False):
+    def _explicit_comm_grads(self, base, resil: bool = False,
+                             bucket_out: bool = False):
         """Wrap the grad computation in a manual shard_map region over the
         data axis: per-shard backward, then explicit bucketed (and
         optionally quantized) psums of the gradients — the comm path this
@@ -500,6 +575,12 @@ class ShardedTrainer:
         f32-castable buffer, so the finite/norm stat costs one fused
         reduction per bucket and NO extra pass over the per-tensor grads.
         The body then returns it as a fourth (replicated) output.
+
+        With ``bucket_out`` (the fused-update path) the reduced flat
+        buckets are returned AS-IS — a list in plan order — instead of
+        being scattered back to per-tensor grads: the fused kernel
+        consumes them directly, so the scatter pass (one extra
+        read+write of every bucket) disappears entirely.
         """
         from .._compat import shard_map
         from .collectives import plan_buckets, psum_compressed
@@ -514,6 +595,7 @@ class ShardedTrainer:
             for n in order:
                 by_dtype.setdefault(jnp.dtype(grads[n].dtype), []).append(n)
             out = dict(grads)
+            flat_buckets: List[jax.Array] = []
             sq = jnp.float32(0.0)
             for dtype, names in by_dtype.items():
                 names = [n for n in names
@@ -533,14 +615,22 @@ class ShardedTrainer:
                         # fused guard stat on the reduced flat bucket
                         sq = sq + jnp.sum(jnp.square(
                             red.astype(jnp.float32)))
+                    if bucket_out:
+                        # fused update consumes the flat bucket directly
+                        flat_buckets.append(red)
+                        continue
                     off = 0
                     for pi, s0, s1 in bucket:
                         pieces[names[pi]].append(red[off:off + (s1 - s0)])
                         off += s1 - s0
+                if bucket_out:
+                    continue
                 for n in names:
                     ps = pieces[n]
                     flat = ps[0] if len(ps) == 1 else jnp.concatenate(ps)
                     out[n] = flat.reshape(grads[n].shape)
+            if bucket_out:
+                return flat_buckets, sq
             return out, sq
 
         if resil:
@@ -583,6 +673,22 @@ class ShardedTrainer:
         lr_mult, wd_mult = dict(self._lr_mult), dict(self._wd_mult)
         base_wd = opt.wd
         needs_rng = type(opt)._needs_rng
+
+        fused = self._fused
+        if fused:
+            from ..analysis.program import tag as _tag_val
+            from ..ops import fused_update as _fu
+            fused_plan = self._fused_plan
+            fused_kind = self._fused_kind
+            n_buckets = len(fused_plan.buckets)
+            # the gate proved these uniform across params
+            lr_common = float(next(iter(lr_mult.values())))
+            wd_common = float(base_wd * next(iter(wd_mult.values())))
+            f_momentum = float(getattr(opt, "momentum", 0.0) or 0.0)
+            f_b1 = float(getattr(opt, "beta1", 0.0) or 0.0)
+            f_b2 = float(getattr(opt, "beta2", 0.0) or 0.0)
+            f_eps = float(getattr(opt, "epsilon", 0.0) or 0.0)
+            f_clip = hyper.get("clip_gradient")
 
         # per-step RNG keys fold from the update counter INSIDE the
         # program (no per-step host->device key transfer — each one is a
@@ -642,9 +748,98 @@ class ShardedTrainer:
 
         explicit = (self.grad_compression is not None
                     and self.data_axis is not None)
+        # zero-copy handoff: on the explicit-comm path (accum == 1) the
+        # reduced flat buckets skip the scatter-back entirely and feed
+        # the fused kernel as-is; under accum > 1 grads must still sum
+        # per-tensor across the scan, so the fused path gathers them
+        explicit_fused = explicit and fused and accum == 1
         if explicit:
             _grads_and_heads = self._explicit_comm_grads(
-                _grads_and_heads, resil=resil is not None)
+                _grads_and_heads, resil=resil is not None,
+                bucket_out=explicit_fused)
+
+        if fused:
+            def _fused_apply(params, grads, opt_state, lr, t, mult, ok):
+                """One fused primitive per bucket.  ``grads`` is either
+                the per-param dict (gathered into plan order here) or,
+                on the explicit-comm path, the already-reduced flat
+                buckets.  The scalar chain below mirrors the unfused
+                ``_functional_step`` op-for-op so parity is bitwise."""
+                lr_eff = lr * lr_common
+                if fused_kind in ("sgd", "sgd_momentum"):
+                    scalars = (lr_eff,)
+                else:
+                    # Adam/AdamW bias correction, exactly as in
+                    # optimizer.py (t cast to the f32 weight dtype)
+                    tf = jnp.asarray(t, dtype=jnp.float32)
+                    lr_t = (lr_eff * jnp.sqrt(1.0 - f_b2 ** tf)
+                            / (1.0 - f_b1 ** tf))
+                    scalars = ((lr_t,) if fused_kind == "adam"
+                               else (lr_t, lr_eff * wd_common))
+                if isinstance(grads, dict):
+                    buckets = [fused_plan.gather(grads, i)
+                               for i in range(n_buckets)]
+                else:
+                    buckets = grads
+                new_w_buckets = []
+                new_opt = {}
+                for i, g in enumerate(buckets):
+                    w = fused_plan.gather(params, i)
+                    # auditor anchor: everything after this tag must be
+                    # the ONE fused eqn (program.fused-update rule)
+                    g = _tag_val(g, label=f"gradbucket:{i}")
+                    leaves, treedef = jax.tree_util.tree_flatten(
+                        opt_state[f"fused:{i}"])
+                    res = _fu.fused_update(
+                        g, w, tuple(leaves), scalars, kind=fused_kind,
+                        mult=mult, ok=ok, momentum=f_momentum,
+                        beta1=f_b1, beta2=f_b2, epsilon=f_eps,
+                        wd=wd_common, rescale_grad=self._rescale_grad,
+                        clip_gradient=f_clip)
+                    new_w_buckets.append(res[0])
+                    new_opt[f"fused:{i}"] = jax.tree_util.tree_unflatten(
+                        treedef, list(res[1:]))
+                return fused_plan.scatter(new_w_buckets), new_opt
+
+        def _unfused_apply(params, grads, opt_state, lr, t, rng, ok):
+            new_params, new_opt = {}, {}
+            for i, n in enumerate(param_names):
+                prng = jax.random.fold_in(rng, i) if needs_rng else None
+                w, g = params[n], grads[n]
+                flat_len = zero_flat[n]
+                if flat_len is not None:
+                    # ZeRO flatten-and-pad: indivisible params (biases,
+                    # BN scales) update in a padded 1-D layout sharded
+                    # over data; the zero-padded tail stays zero under
+                    # every elementwise optimizer (g=0, w=0)
+                    shape = w.shape
+                    pad = flat_len - int(np.prod(shape))
+                    w = jnp.pad(w.reshape(-1), (0, pad))
+                    g = jnp.pad(g.reshape(-1), (0, pad))
+                if zero_shardings[n] is not None:
+                    # ZeRO: constrain grad + weight to the data-sharded
+                    # spec — XLA emits reduce-scatter for the grad sum and
+                    # a local slice of the replicated weight; the update
+                    # below then runs on 1/N of the param, and the
+                    # replicated out_sharding all-gathers the result
+                    g = jax.lax.with_sharding_constraint(g, zero_shardings[n])
+                    w = jax.lax.with_sharding_constraint(w, zero_shardings[n])
+                w2, s2 = step_fn(hyper, w, g, opt_state[n],
+                                 lr * lr_mult[n], base_wd * wd_mult[n],
+                                 t, prng)
+                if flat_len is not None:
+                    w2 = w2[:int(np.prod(shape))].reshape(shape)
+                if ok is not None:
+                    # the non-finite gate: a bad step selects the OLD
+                    # param/opt buffers, so the update is a bitwise no-op
+                    # while staying donation-safe (same program, same
+                    # buffer flow) and requiring no host sync
+                    w2 = jnp.where(ok, w2, params[n])
+                    s2 = jax.tree_util.tree_map(
+                        lambda a, b: jnp.where(ok, a, b), s2, opt_state[n])
+                new_params[n] = w2
+                new_opt[n] = s2
+            return new_params, new_opt
 
         def train_step(params, aux, opt_state, batch, lr, t, base_key,
                        gstate=None):
@@ -696,10 +891,14 @@ class ShardedTrainer:
 
             # identity-tag the grads for the static auditor's HBM-pass
             # counter: mxtpu_tag lowers to nothing, so HLO, executables
-            # and compile-cache keys are unchanged (analysis/program.py)
-            grads = _mark_grads(grads)
+            # and compile-cache keys are unchanged (analysis/program.py).
+            # The fused path tags its flat buckets (gradbucket:<i>)
+            # inside _fused_apply instead.
+            if not fused:
+                grads = _mark_grads(grads)
 
             ok = None
+            mult = None
             if resil is not None:
                 if sq is None:
                     sq = resilience.tree_sq_sum(grads)
@@ -709,7 +908,6 @@ class ShardedTrainer:
                 ok = jnp.isfinite(sq)
                 eff_norm = jnp.sqrt(sq) * jnp.float32(
                     abs(self._rescale_grad) or 1.0)
-                mult = None
                 if scaling:
                     inv_scale = jnp.float32(1.0) / gstate["scale"]
                     eff_norm = eff_norm * inv_scale
@@ -720,50 +918,24 @@ class ShardedTrainer:
                         jnp.float32(resil.clip_global_norm)
                         / jnp.maximum(eff_norm, jnp.float32(1e-12)))
                     mult = coef if mult is None else mult * coef
-                if mult is not None:
+                if mult is not None and not fused:
                     # ONE combined multiplier (unscale x clip) applied
                     # once; with neither feature on, no multiply at all —
                     # a guard-on clean run stays bitwise identical to
-                    # guard-off (pinned by tests/test_resilience.py)
+                    # guard-off (pinned by tests/test_resilience.py).
+                    # On the fused path mult rides INTO the kernel.
                     grads = {n: g * mult.astype(g.dtype)
                              for n, g in grads.items()}
-            new_params, new_opt = {}, {}
-            for i, n in enumerate(param_names):
-                prng = jax.random.fold_in(rng, i) if needs_rng else None
-                w, g = params[n], grads[n]
-                flat_len = zero_flat[n]
-                if flat_len is not None:
-                    # ZeRO flatten-and-pad: indivisible params (biases,
-                    # BN scales) update in a padded 1-D layout sharded
-                    # over data; the zero-padded tail stays zero under
-                    # every elementwise optimizer (g=0, w=0)
-                    shape = w.shape
-                    pad = flat_len - int(np.prod(shape))
-                    w = jnp.pad(w.reshape(-1), (0, pad))
-                    g = jnp.pad(g.reshape(-1), (0, pad))
-                if zero_shardings[n] is not None:
-                    # ZeRO: constrain grad + weight to the data-sharded
-                    # spec — XLA emits reduce-scatter for the grad sum and
-                    # a local slice of the replicated weight; the update
-                    # below then runs on 1/N of the param, and the
-                    # replicated out_sharding all-gathers the result
-                    g = jax.lax.with_sharding_constraint(g, zero_shardings[n])
-                    w = jax.lax.with_sharding_constraint(w, zero_shardings[n])
-                w2, s2 = step_fn(hyper, w, g, opt_state[n],
-                                 lr * lr_mult[n], base_wd * wd_mult[n],
-                                 t, prng)
-                if flat_len is not None:
-                    w2 = w2[:int(np.prod(shape))].reshape(shape)
-                if resil is not None:
-                    # the non-finite gate: a bad step selects the OLD
-                    # param/opt buffers, so the update is a bitwise no-op
-                    # while staying donation-safe (same program, same
-                    # buffer flow) and requiring no host sync
-                    w2 = jnp.where(ok, w2, params[n])
-                    s2 = jax.tree_util.tree_map(
-                        lambda a, b: jnp.where(ok, a, b), s2, opt_state[n])
-                new_params[n] = w2
-                new_opt[n] = s2
+            if fused:
+                # single streaming pass per bucket: combined multiplier,
+                # guard verdict and the whole optimizer update ride ONE
+                # primitive (ops/fused_update.py); the where-gating lives
+                # inside it, so a bad step stays a bitwise no-op
+                new_params, new_opt = _fused_apply(
+                    params, grads, opt_state, lr, t, mult, ok)
+            else:
+                new_params, new_opt = _unfused_apply(
+                    params, grads, opt_state, lr, t, rng, ok)
             new_aux = dict(aux)
             if resil is not None:
                 for k, v in auxu.items():
@@ -801,9 +973,12 @@ class ShardedTrainer:
         p_shard = {n: NamedSharding(self.mesh, self.rules.spec_for(n))
                    for n in param_names}
         a_shard = {n: replicated(self.mesh) for n in self._aux_names}
-        o_shard = {n: jax.tree.map(
-            lambda _, _s=NamedSharding(self.mesh, self._zero_specs[n]): _s,
-            self._opt_state[n]) for n in param_names}
+        # opt state keys are param names on the unfused path, "fused:<i>"
+        # bucket keys on the fused path (always replicated there)
+        o_shard = {k: jax.tree.map(
+            lambda _, _s=NamedSharding(
+                self.mesh, self._zero_specs.get(k, P())): _s,
+            self._opt_state[k]) for k in self._opt_state}
         # retrace guards: the counter bump is a host side effect, so it
         # fires only while jax traces the function — in steady state each
         # program's count stays at exactly 1 (asserted by
@@ -894,6 +1069,7 @@ class ShardedTrainer:
                                  for n, s in self._zero_specs.items()),
             "grad_compression": self.grad_compression,
             "grad_bucket_bytes": self.grad_bucket_bytes,
+            "fused": self._fused_kind if self._fused else None,
             "data_axis": self.data_axis,
             "rules": sorted((n, str(self.rules.spec_for(n)))
                             for n in self._param_names),
@@ -915,9 +1091,9 @@ class ShardedTrainer:
                    for n, v in self._params.items()}
         a_avals = {n: sds(v.shape, v.dtype, sharding=v.sharding)
                    for n, v in self._aux.items()}
-        o_avals = {n: jax.tree.map(
+        o_avals = {k: jax.tree.map(
             lambda l: sds(l.shape, l.dtype, sharding=l.sharding),
-            self._opt_state[n]) for n in self._param_names}
+            self._opt_state[k]) for k in self._opt_state}
         bkey = self._base_key
         k_aval = sds(bkey.shape, bkey.dtype,
                      sharding=getattr(bkey, "sharding", None))
@@ -1275,17 +1451,18 @@ class ShardedTrainer:
 
     def _state_arrays(self) -> Dict[str, jax.Array]:
         """Flat ``{name: array}`` view of the full trainer state.  Names
-        are namespaced (``param:``/``aux:``/``opt:<param>:<leaf>``) so one
+        are namespaced (``param:``/``aux:``/``opt:<key>:<leaf>`` where
+        ``<key>`` is a param name or a fused bucket ``fused:<i>``) so one
         checkpoint dict round-trips through CheckpointManager and the
         optimizer pytree re-assembles leaf-by-leaf on restore."""
         if not self._bound:
             raise MXNetError("call bind() before save_state/restore_state")
         arrays = {f"param:{n}": self._params[n] for n in self._param_names}
         arrays.update({f"aux:{n}": self._aux[n] for n in self._aux_names})
-        for n in self._param_names:
+        for key in self._opt_state:
             for i, leaf in enumerate(
-                    jax.tree_util.tree_leaves(self._opt_state[n])):
-                arrays[f"opt:{n}:{i}"] = leaf
+                    jax.tree_util.tree_leaves(self._opt_state[key])):
+                arrays[f"opt:{key}:{i}"] = leaf
         return arrays
 
     def _state_meta(self, extra_meta=None) -> Dict[str, Any]:
@@ -1354,12 +1531,12 @@ class ShardedTrainer:
             self._params[n] = arrays[f"param:{n}"]
         for n in self._aux_names:
             self._aux[n] = arrays[f"aux:{n}"]
-        for n in self._param_names:
-            treedef = jax.tree_util.tree_structure(self._opt_state[n])
-            leaves = [arrays[f"opt:{n}:{i}"]
+        for key in list(self._opt_state):
+            treedef = jax.tree_util.tree_structure(self._opt_state[key])
+            leaves = [arrays[f"opt:{key}:{i}"]
                       for i in range(treedef.num_leaves)]
-            self._opt_state[n] = jax.tree_util.tree_unflatten(treedef,
-                                                              leaves)
+            self._opt_state[key] = jax.tree_util.tree_unflatten(treedef,
+                                                                leaves)
         self._num_update = int(meta.get("num_update", step))
         if "rng_key" in meta:
             # the base key is a program ARGUMENT (pinned placement via

@@ -17,6 +17,12 @@ export XLA_FLAGS="${XLA_FLAGS:---xla_force_host_platform_device_count=8}"
 mode="${1:-all}"
 
 if [[ "$mode" != "--tests-only" ]]; then
+    # The gate's acceptance programs + regression corpus also enforce
+    # the r8 fused-update memory contract: every tagged grad bucket in
+    # a fused trainer program must audit at exactly 1 read / 1 write
+    # (rule program.fused-update, docs/static_analysis.md
+    # "Stream-once operand attribution") — a new sweep over the bucket
+    # fails CI here before any benchmark runs.
     echo "== staticcheck gate (tools/staticcheck.py, docs/static_analysis.md) =="
     python tools/staticcheck.py gate
     rc=$?

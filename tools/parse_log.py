@@ -14,6 +14,13 @@ step-overhead changes.
 (``Epoch[N] Resilience: skipped=... overflows=... rollbacks=...
 loss-scale=... lr-scale=...``) of two runs — the triage view for
 stability changes (docs/resilience.md).
+
+``--diff-audit A B`` diffs two ``bench.py --audit`` reports
+(BENCH_r08.json-style: a JSON array, or one JSON object per line): for
+every audited config present in both, the per-bucket HBM pass counts
+(reads/writes), bucket count, findings, and pass verdict — the
+regression-triage view for grad-bucket memory-traffic changes
+(docs/static_analysis.md).
 """
 import argparse
 import json
@@ -149,6 +156,78 @@ def diff_resilience(path_a, path_b):
     return 0
 
 
+def read_audits(path):
+    """{metric: row} for the grad-bucket audit rows of a ``bench.py
+    --audit`` report.  Accepts either a whole-file JSON array (the
+    BENCH_r08.json format) or one JSON object per line (tee'd stdout);
+    audit rows are the ones carrying ``writes_per_bucket``."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        recs = json.loads(text)
+        if isinstance(recs, dict):
+            recs = [recs]
+    except ValueError:
+        recs = []
+        for line in text.splitlines():
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                recs.append(json.loads(line))
+            except ValueError:
+                continue
+    # pre-r8 reports name the (only) legacy chain without an
+    # ", unfused" label; normalize it away so r7->r8 diffs line up the
+    # like-for-like rows (the fused rows stay distinct)
+    return {rec["metric"].replace(", unfused,", ","): rec for rec in recs
+            if isinstance(rec, dict) and "writes_per_bucket" in rec}
+
+
+AUDIT_KEYS = (("reads", "value"), ("writes", "writes_per_bucket"),
+              ("buckets", "buckets"), ("findings", "findings"),
+              ("pass", "pass"))
+
+
+def diff_audits(path_a, path_b):
+    """Per-config HBM-pass comparison of two audit reports (B - A): the
+    triage view for 'did this change add a sweep over the grad bucket'."""
+    a, b = read_audits(path_a), read_audits(path_b)
+    common = [m for m in a if m in b]
+    if not common:
+        print("no common grad-bucket audit rows between the two reports",
+              file=sys.stderr)
+        return 1
+    worse = 0
+    print("| config | " + " | ".join(
+        f"{k} A | {k} B | Δ" for k, _ in AUDIT_KEYS) + " |")
+    print("|" + "---|" * (1 + 3 * len(AUDIT_KEYS)))
+    for metric in common:
+        ra, rb = a[metric], b[metric]
+        cells = []
+        for _, key in AUDIT_KEYS:
+            va, vb = ra.get(key), rb.get(key)
+            for v in (va, vb):
+                cells.append("" if v is None else f"{v:g}"
+                             if isinstance(v, (int, float))
+                             and not isinstance(v, bool) else str(v))
+            if (isinstance(va, (int, float)) and isinstance(vb, (int, float))
+                    and not isinstance(va, bool) and not isinstance(vb, bool)):
+                cells.append(f"{vb - va:+g}")
+                if key in ("value", "writes_per_bucket", "findings"):
+                    worse += vb > va
+            else:
+                cells.append("")
+        print(f"| {metric} | " + " | ".join(cells) + " |")
+    only = [m for m in (set(a) | set(b)) if m not in common]
+    if only:
+        print(f"\n(unmatched configs: {sorted(only)})", file=sys.stderr)
+    if worse:
+        print(f"{worse} count(s) regressed (B > A)", file=sys.stderr)
+        return 1
+    return 0
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("logfile", nargs="?", help="default: stdin")
@@ -159,11 +238,18 @@ def main():
                     help="diff the guardrail counters (skipped/overflows/"
                     "rollbacks/loss-scale/lr-scale) of two runs' epoch "
                     "logs, B relative to A")
+    ap.add_argument("--diff-audit", nargs=2, metavar=("A", "B"),
+                    help="diff the grad-bucket HBM pass counts of two "
+                    "bench.py --audit reports (reads/writes/buckets/"
+                    "findings per config, B relative to A; exits 1 if "
+                    "any count regressed)")
     args = ap.parse_args()
     if args.diff_profile:
         return diff_profiles(*args.diff_profile)
     if args.diff_resilience:
         return diff_resilience(*args.diff_resilience)
+    if args.diff_audit:
+        return diff_audits(*args.diff_audit)
     lines = (open(args.logfile).readlines() if args.logfile
              else sys.stdin.readlines())
     rows = parse(lines)
