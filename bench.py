@@ -268,10 +268,16 @@ def bench_grad_comm(args):
     for label, kw in (("bucketed-4MiB", {}),
                       ("bucketed-1MiB", {"bucket_bytes": 1 << 20}),
                       ("bucketed-4MiB-int8", {"compression": "int8"}),
-                      ("bucketed-4MiB-bf16", {"compression": "bf16"})):
+                      ("bucketed-4MiB-bf16", {"compression": "bf16"}),
+                      ("bucketed-4MiB-fp8", {"compression": "fp8"})):
         with count_collectives() as stats:
             allreduce_sum(groups, **kw)
         t = timed(lambda: allreduce_sum(groups, **kw))
+        # wire bytes use the COMPRESSED element width (int8/fp8 payloads
+        # are 1 B/elem on the interconnect even though they reduce on
+        # wide lanes); total_bytes stays the logical f32 volume so the
+        # GiB/s column is comparable across rows.
+        wire_bytes = stats.total_wire_bytes
         rows.append({
             "metric": f"grad all-reduce {label} "
                       f"({len(shapes)} tensors, "
@@ -282,6 +288,9 @@ def bench_grad_comm(args):
             "vs_baseline": None,
             "step_ms": round(1000 * t, 2),
             "collectives": stats.count,
+            "wire_bytes": wire_bytes,
+            "compression_ratio": round(total_bytes / wire_bytes, 2)
+            if wire_bytes else None,
             "per_tensor_collectives": per_tensor_n,
             "per_tensor_ms": round(1000 * t_per_tensor, 2),
             "speedup_vs_per_tensor": round(t_per_tensor / t, 2),
@@ -584,8 +593,16 @@ def bench_audit(args):
     guardrail stack — every extra count is one more full sweep of the
     gradient bytes through HBM per step).  The audit must also be
     CLEAN (zero unsuppressed findings) — a finding here is a real
-    hazard in a shipped step program, and the row goes red.  Results
-    land in ``BENCH_r08.json`` next to this script.
+    hazard in a shipped step program, and the row goes red.
+
+    r9 adds the wire-bytes rows: each config re-traced with
+    ``grad_compression`` int8/fp8 (error feedback on, the default) and
+    audited with ``expect_wire_itemsize=1``, recording the auditor's
+    ``hbm_bytes`` metric — collective payload bytes at the narrowest
+    same-shape width in each psum's backward cone, vs the f32 bytes
+    the same reduction would move uncompressed.  Target: ratio >= 2
+    and the ``program.hbm-bytes`` rule silent.  Results land in
+    ``BENCH_r09.json`` next to this script.
     """
     import jax
     import mxnet_tpu as mx
@@ -654,8 +671,40 @@ def bench_audit(args):
                 "n_devices": len(jax.devices()),
             })
             print(json.dumps(rows[-1]))
+
+    for name, make_sym, dshapes, lshapes, kw in configs:
+        from mxnet_tpu.parallel import ShardedTrainer, make_mesh
+        for compression in ("int8", "fp8"):
+            mx.random.seed(7)
+            tr = ShardedTrainer(make_sym(),
+                                mesh=make_mesh({"data": len(jax.devices())}),
+                                grad_compression=compression, **kw)
+            tr.bind(data_shapes=dshapes, label_shapes=lshapes)
+            t0 = time.perf_counter()
+            report = analysis.audit_trainer(tr, programs=("train",))
+            elapsed = time.perf_counter() - t0
+            hb = report.metrics.get("trainer.train", {}).get("hbm_bytes", {})
+            ratio = hb.get("ratio")
+            passed = bool(report.clean) and ratio is not None and ratio >= 2.0
+            rows.append({
+                "metric": f"collective wire bytes ({name}, {compression}+ef, "
+                          "audited train step)",
+                "value": ratio if ratio is None else round(ratio, 2),
+                "unit": "f32-bytes / wire-bytes",
+                "vs_baseline": None,
+                "wire_bytes": hb.get("wire_bytes"),
+                "f32_bytes": hb.get("f32_bytes"),
+                "grad_compression": compression,
+                "clean": report.clean,
+                "findings": len(report.unsuppressed()),
+                "target": "CLEAN; >= 2x byte reduction on the grad wire",
+                "pass": passed,
+                "audit_s": round(elapsed, 2),
+                "n_devices": len(jax.devices()),
+            })
+            print(json.dumps(rows[-1]))
     out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                       "BENCH_r08.json")
+                       "BENCH_r09.json")
     with open(out, "w") as f:
         json.dump(rows, f, indent=2)
         f.write("\n")
@@ -670,10 +719,9 @@ def bench_twin_gap(args):
     ``measure`` uses, then times the framework ResNet-50 trainer on an
     identical config — batch, image edge, bf16 activation flow with f32
     master params, SGD momentum 0.9, weight decay OFF on both sides
-    (per-param wd — wd_mult=0 on gamma/beta/bias — is not yet
-    fused-eligible, and the tax referee must compare the fused
-    framework path; extending eligibility to per-param wd is the
-    ROADMAP follow-up).  The delta between the two slopes IS the
+    (so the twin's plain update matches the framework's math exactly;
+    per-param wd fuses too since r9, via the per-bucket wd segment
+    vector).  The delta between the two slopes IS the
     framework tax.  r4 measured it at ~14 ms/step with the unfused
     18-pass update chain; with the fused single-pass kernel the target
     is <2 ms/step on the TPU headline config (``--twin-batch 256
@@ -991,7 +1039,7 @@ def main():
     ap.add_argument("--d-model", type=int, default=512)
     ap.add_argument("--num-layers", type=int, default=6)
     ap.add_argument("--grad-compression", default="none",
-                    choices=("none", "int8", "bf16"),
+                    choices=("none", "int8", "bf16", "fp8"),
                     help="quantized gradient all-reduce wire format "
                     "(dp meshes; see docs/perf.md gradient communication)")
     ap.add_argument("--profile-step", action="store_true",
@@ -1009,9 +1057,10 @@ def main():
                     "mesh; target <2%% (docs/resilience.md)")
     ap.add_argument("--audit", action="store_true",
                     help="statically audit the acceptance step programs "
-                    "(mxnet_tpu.analysis), fused AND unfused, and "
-                    "record grad-bucket HBM pass counts -> "
-                    "BENCH_r08.json (docs/static_analysis.md)")
+                    "(mxnet_tpu.analysis), fused AND unfused, plus the "
+                    "quantized-wire configs, and record grad-bucket HBM "
+                    "pass counts + collective wire bytes -> "
+                    "BENCH_r09.json (docs/static_analysis.md)")
     ap.add_argument("--twin-gap", action="store_true",
                     help="framework ResNet-50 step vs the raw-JAX "
                     "tools/resnet_probe.py twin under one slope "

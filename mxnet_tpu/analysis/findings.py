@@ -50,6 +50,12 @@ RULES: Dict[str, Tuple[str, str]] = {
         "error", "a fused-update program breaks the single-pass HBM "
         "contract: a grad bucket is traversed more than once "
         "(reads/writes > 1) or the fused primitive/tags are missing"),
+    "program.hbm-bytes": (
+        "error", "a quantized-collective program breaks the wire-bytes "
+        "contract: a bucket-scale floating reduce collective puts a "
+        "wider payload on the wire than the configured compression "
+        "allows (the quantize was silently dropped), or no quantized "
+        "reduction is in the trace at all"),
     "source.host-sync": (
         "error", ".asnumpy()/.asscalar()/float()/np.* applied to a traced "
         "value inside a jitted function (breaks tracing or silently "

@@ -21,6 +21,18 @@ with the identity primitive ``mxtpu_tag`` (zero HLO footprint), and the
 auditor counts how many program eqns traverse each gradient buffer on the
 update path, aggregated onto the flat comm buckets — the measuring stick
 for ROADMAP item 4's single-pass fused update (target: 1 read / 1 write).
+
+And the **HBM-bytes metric** (``program.hbm-bytes``): every reduce
+collective (``psum``/``psum2``) gets a dtype-width-weighted wire-bytes
+row.  A quantized all-reduce accumulates on wide lanes for exactness
+(int8 payload sums on int32, fp8 on f32 — see ``psum_compressed``), so
+the collective's own operand dtype overstates the wire: the auditor
+walks the operand's backward cone for the narrowest same-shape value
+(the ``convert_element_type`` into int8/fp8 that formed the payload)
+and charges THAT element width.  An fp8/int8 bucket is therefore ¼ the
+bytes of its f32 twin in the metric, and auditing with
+``expect_wire_itemsize`` turns silent re-widening (a refactor dropping
+the quantize) into a finding.
 """
 
 from __future__ import annotations
@@ -49,6 +61,7 @@ __all__ = [
     "AuditConfig", "tag", "mark_grads", "audit_traced", "audit_trainer",
     "audit_executor", "audit_module", "audit_optimizer",
     "audit_on_compile", "assert_program_clean", "update_passes",
+    "collective_wire_rows",
 ]
 
 
@@ -102,6 +115,12 @@ STREAM_ONCE_PRIMS = frozenset({
     "pallas_call", "mxtpu_fused_update",
 })
 
+#: reduce collectives whose operands cross the interconnect (psum at the
+#: jax API level; psum2 is what shard_map jaxprs spell it on this jax)
+REDUCE_COLLECTIVE_PRIMS = frozenset({
+    "psum", "psum2", "all_reduce", "reduce_scatter",
+})
+
 _64BIT_KINDS = ("f", "i", "u", "c")
 
 
@@ -112,9 +131,14 @@ class AuditConfig:
     widen_bytes_threshold: int = 65536     # large 64-bit intermediate floor
     compile: bool = True                   # compile for sharding checks
     count_hbm: bool = True
+    # reduce collectives whose f32-width payload is below this many bytes
+    # are exempt from the hbm-bytes rule (loss/grad-norm scalars ride
+    # plain psum by design; only bucket-scale payloads must quantize)
+    collective_bytes_floor: int = 1024
     host_transfer_prims: frozenset = HOST_TRANSFER_PRIMS
     free_pass_prims: frozenset = FREE_PASS_PRIMS
     stream_once_prims: frozenset = STREAM_ONCE_PRIMS
+    reduce_collective_prims: frozenset = REDUCE_COLLECTIVE_PRIMS
 
 
 def _is64(aval) -> bool:
@@ -563,6 +587,124 @@ def _check_fused_update(per: Dict[str, Dict[str, int]], program: str,
 
 
 # ----------------------------------------------------------------------
+# HBM-bytes: dtype-width-weighted wire traffic of reduce collectives
+# ----------------------------------------------------------------------
+
+_WIRE_CONE_DEPTH = 8
+
+
+def collective_wire_rows(closed, config: Optional[AuditConfig] = None
+                         ) -> List[Dict[str, Any]]:
+    """One row per reduce-collective operand: ``{primitive, shape, dtype,
+    elems, wire_itemsize, wire_bytes, f32_bytes, float_payload}``.
+
+    ``wire_itemsize`` is the narrowest element width found in the
+    operand's backward cone among SAME-SHAPE values (depth-bounded walk
+    through the producing eqns).  A quantized payload accumulates on
+    wide lanes — int8 sums on int32, fp8 on f32 — so the collective's
+    operand dtype is the LANE width; the narrow ``convert_element_type``
+    that formed the payload is what crosses the wire, and the same-shape
+    restriction is what keeps unrelated narrow values (bool masks,
+    scalar flags) out of the cone.  ``float_payload`` marks rows whose
+    cone carries floating data (gradient buckets), which is what the
+    ``program.hbm-bytes`` rule quantifies; ``f32_bytes`` is the
+    unquantized twin's traffic (elems x 4) for ratio math.
+    """
+    config = config or AuditConfig()
+    rows: List[Dict[str, Any]] = []
+    for level in _all_jaxpr_levels(closed):
+        jaxpr = level.jaxpr
+        producer: Dict[Any, Any] = {}
+        for eqn in jaxpr.eqns:
+            for ov in eqn.outvars:
+                producer[ov] = eqn
+        for eqn in jaxpr.eqns:
+            if eqn.primitive.name not in config.reduce_collective_prims:
+                continue
+            for v in eqn.invars:
+                if isinstance(v, _jex_core.Literal):
+                    continue
+                aval = v.aval
+                dt = getattr(aval, "dtype", None)
+                if dt is None:
+                    continue
+                shape = tuple(aval.shape)
+                elems = int(np.prod(shape, dtype=np.int64))
+                wire = dt.itemsize
+                floaty = jnp.issubdtype(dt, jnp.floating)
+                seen = {v}
+                frontier = [v]
+                for _ in range(_WIRE_CONE_DEPTH):
+                    nxt = []
+                    for fv in frontier:
+                        pe = producer.get(fv)
+                        if pe is None:
+                            continue
+                        for iv in pe.invars:
+                            if isinstance(iv, _jex_core.Literal) \
+                                    or iv in seen:
+                                continue
+                            seen.add(iv)
+                            idt = getattr(iv.aval, "dtype", None)
+                            if idt is None or \
+                                    tuple(iv.aval.shape) != shape:
+                                continue
+                            wire = min(wire, idt.itemsize)
+                            floaty = floaty or jnp.issubdtype(
+                                idt, jnp.floating)
+                            nxt.append(iv)
+                    frontier = nxt
+                    if not frontier:
+                        break
+                rows.append({
+                    "primitive": eqn.primitive.name,
+                    "shape": list(shape),
+                    "dtype": str(dt),
+                    "elems": elems,
+                    "wire_itemsize": int(wire),
+                    "wire_bytes": elems * int(wire),
+                    "f32_bytes": elems * 4,
+                    "float_payload": bool(floaty),
+                })
+    return rows
+
+
+def _check_hbm_bytes(rows: List[Dict[str, Any]], expect_itemsize: int,
+                     program: str, report: Report,
+                     config: AuditConfig) -> None:
+    """The ``program.hbm-bytes`` rule: with ``expect_wire_itemsize`` set
+    (the trainer runs a quantized ``grad_compression``), every bucket-
+    scale floating reduce collective must put a payload at most that
+    wide on the wire — a wider payload means the quantize was silently
+    dropped and the program re-widened to f32."""
+    big = [r for r in rows if r["float_payload"]
+           and r["f32_bytes"] >= config.collective_bytes_floor]
+    if not big:
+        report.add(Finding(
+            "program.hbm-bytes",
+            "expect_wire_itemsize was set but the program has no bucket-"
+            "scale floating reduce collective — the quantized grad "
+            "reduction is not in the trace",
+            program=program,
+            details={"expect_wire_itemsize": expect_itemsize}))
+        return
+    for r in big:
+        if r["wire_itemsize"] > expect_itemsize:
+            report.add(Finding(
+                "program.hbm-bytes",
+                f"reduce collective `{r['primitive']}` over "
+                f"{r['dtype']}{r['shape']} puts {r['wire_itemsize']} "
+                f"bytes/elem on the wire — expected <= {expect_itemsize} "
+                "(quantized); the bucket silently widened back to full "
+                "precision",
+                program=program,
+                details={**{k: r[k] for k in
+                            ("primitive", "dtype", "wire_itemsize",
+                             "wire_bytes", "f32_bytes")},
+                         "expect_wire_itemsize": expect_itemsize}))
+
+
+# ----------------------------------------------------------------------
 # Generic entry: audit one traced program
 # ----------------------------------------------------------------------
 
@@ -572,6 +714,7 @@ def audit_traced(traced, program: str,
                  carry_pairs: Optional[Sequence[Tuple[int, int, str]]] = None,
                  replicated_out: Optional[Sequence[Tuple[int, str]]] = None,
                  expect_fused: bool = False,
+                 expect_wire_itemsize: Optional[int] = None,
                  config: Optional[AuditConfig] = None,
                  report: Optional[Report] = None) -> Report:
     """Run every program rule over one ``jax.stages.Traced``.
@@ -585,6 +728,10 @@ def audit_traced(traced, program: str,
     ``expect_fused``: assert the single-pass fused-update contract — the
     program must contain ``gradbucket:<i>`` tags and traverse each
     exactly once (``program.fused-update`` findings otherwise).
+    ``expect_wire_itemsize``: assert the quantized-collective contract —
+    every bucket-scale floating reduce collective must put at most this
+    many bytes/elem on the wire (``program.hbm-bytes`` findings
+    otherwise; the wire-bytes rows land in the metrics either way).
     """
     config = config or AuditConfig()
     report = report if report is not None else Report(mode="audit")
@@ -624,6 +771,20 @@ def audit_traced(traced, program: str,
             metrics["hbm_passes"] = {"per_grad": per}
         if expect_fused:
             _check_fused_update(per, program, report)
+        rows = collective_wire_rows(closed, config)
+        if rows:
+            frows = [r for r in rows if r["float_payload"]]
+            wire = sum(r["wire_bytes"] for r in frows)
+            full = sum(r["f32_bytes"] for r in frows)
+            metrics["hbm_bytes"] = {
+                "collectives": rows,
+                "wire_bytes": wire,
+                "f32_bytes": full,
+                "ratio": (full / wire) if wire else None,
+            }
+        if expect_wire_itemsize is not None:
+            _check_hbm_bytes(rows, expect_wire_itemsize, program,
+                             report, config)
     report.metrics[program] = metrics
     profiler.record_audit(program, len(report.findings) - n0,
                           time.perf_counter() - t0)
@@ -692,11 +853,17 @@ def audit_trainer(trainer, programs: Sequence[str] = ("train", "train_acc"),
                     replicated_out.append((out_after_heads + j, gnames[j]))
         fused_plan = (trainer._fused_plan
                       if getattr(trainer, "_fused", False) else None)
+        expect_wire = None
+        if kind in ("train", "train_acc") and \
+                getattr(trainer, "grad_compression", None) is not None:
+            from .. import quant
+            expect_wire = quant.wire_itemsize(trainer.grad_compression)
         audit_traced(
             traced, label, donate_flat=donate_flat,
             carry_pairs=carry_pairs, replicated_out=replicated_out,
             expect_fused=(fused_plan is not None
                           and kind in ("train", "train_acc")),
+            expect_wire_itemsize=expect_wire,
             config=config, report=report)
         if config.count_hbm and kind in ("train", "train_acc"):
             per = report.metrics[label].get(

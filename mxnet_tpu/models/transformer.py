@@ -6,10 +6,12 @@ with a ``seq`` axis is active (``mxnet_tpu.parallel.default_mesh``) —
 the capability upgrade over the reference's bucketed-RNN story
 (SURVEY §2.4/§7 item 10).
 
-Shapes are baked per config (batch/seq len) because the 2016-era
-``FullyConnected`` flattens trailing dims, so per-position projections go
-through explicit ``Reshape``s — the same static-unroll style as the
-reference's ``example/rnn/lstm.py``.
+Seq len is baked per config because the 2016-era ``FullyConnected``
+flattens trailing dims, so per-position projections go through explicit
+``Reshape``s — the same static-unroll style as the reference's
+``example/rnn/lstm.py``.  The batch dim is a ``-1`` wildcard
+everywhere, so one symbol serves both the global-shape implicit-comm
+path and the per-device shards of the explicit shard_map path.
 """
 import contextlib
 
@@ -17,11 +19,14 @@ from .. import symbol as sym
 from ..attribute import AttrScope
 
 
-def _linear(x, b, l, d_in, d_out, name):
-    """Per-position linear: [B, L, d_in] -> [B, L, d_out]."""
-    h = sym.Reshape(data=x, shape=(b * l, d_in))
-    h = sym.FullyConnected(data=h, num_hidden=d_out, name=name)
-    return sym.Reshape(data=h, shape=(b, l, d_out))
+def _linear(x, b, l, d_in, d_out, name, quant=""):
+    """Per-position linear: [B, L, d_in] -> [B, L, d_out].  The batch
+    dim stays a -1 wildcard so the same symbol evaluates on per-device
+    shards inside the explicit-communication shard_map path (local
+    batch = B/ndev)."""
+    h = sym.Reshape(data=x, shape=(-1, d_in))
+    h = sym.FullyConnected(data=h, num_hidden=d_out, name=name, quant=quant)
+    return sym.Reshape(data=h, shape=(-1, l, d_out))
 
 
 def _layernorm(x, name):
@@ -29,7 +34,7 @@ def _layernorm(x, name):
 
 
 def transformer_block(x, b, l, d, heads, name, causal=True,
-                      attn_block_size=0):
+                      attn_block_size=0, quant=""):
     hd = d // heads
 
     # heads stay at dim 2 ([B, L, H, hd] — the natural post-projection
@@ -41,29 +46,29 @@ def transformer_block(x, b, l, d, heads, name, causal=True,
     # native-layout kernels are written, interpret-verified, and switch
     # on when Mosaic supports them; see flash_attention.py)
     def split_heads(t):
-        return sym.Reshape(data=t, shape=(b, l, heads, hd))
+        return sym.Reshape(data=t, shape=(-1, l, heads, hd))
 
     h = _layernorm(x, f"{name}_ln1")
-    q = split_heads(_linear(h, b, l, d, d, f"{name}_q"))
-    k = split_heads(_linear(h, b, l, d, d, f"{name}_k"))
-    v = split_heads(_linear(h, b, l, d, d, f"{name}_v"))
+    q = split_heads(_linear(h, b, l, d, d, f"{name}_q", quant=quant))
+    k = split_heads(_linear(h, b, l, d, d, f"{name}_k", quant=quant))
+    v = split_heads(_linear(h, b, l, d, d, f"{name}_v", quant=quant))
     att = sym.RingAttention(query=q, key=k, value=v, causal=causal,
                             block_size=attn_block_size, layout="blhd",
                             name=f"{name}_attn")
-    att = sym.Reshape(data=att, shape=(b, l, d))
-    att = _linear(att, b, l, d, d, f"{name}_proj")
+    att = sym.Reshape(data=att, shape=(-1, l, d))
+    att = _linear(att, b, l, d, d, f"{name}_proj", quant=quant)
     x = x + att
     h = _layernorm(x, f"{name}_ln2")
-    h = _linear(h, b, l, d, 4 * d, f"{name}_ffn1")
+    h = _linear(h, b, l, d, 4 * d, f"{name}_ffn1", quant=quant)
     h = sym.Activation(data=h, act_type="relu")
-    h = _linear(h, b, l, 4 * d, d, f"{name}_ffn2")
+    h = _linear(h, b, l, 4 * d, d, f"{name}_ffn2", quant=quant)
     return x + h
 
 
 def transformer_lm(vocab_size=256, num_layers=2, d_model=64, heads=4,
                    batch_size=8, seq_len=64, causal=True, remat=False,
                    head_same_dtype=False, loss_head=False,
-                   attn_block_size=0, ignore_label=None):
+                   attn_block_size=0, ignore_label=None, quant=None):
     """Build the LM symbol; inputs ``data``/``softmax_label`` are
     ``[batch, seq]`` token ids.  ``remat=True`` wraps each block in a
     ``remat_scope`` so backward recomputes the block from its boundary
@@ -79,7 +84,15 @@ def transformer_lm(vocab_size=256, num_layers=2, d_model=64, heads=4,
     whose label equals it out of the loss AND its gradient (×1.0 at
     every valid position, so masked and unmasked runs agree bitwise at
     valid positions) — the correctness mask for bucket-padded batches
-    (compile_cache.BucketPolicy / io.pad_batch_to_bucket)."""
+    (compile_cache.BucketPolicy / io.pad_batch_to_bucket).
+    ``quant`` routes the block projections (q/k/v/proj/ffn1/ffn2)
+    through the block-scaled fp8 matmul path (mxnet_tpu.quant: e4m3
+    fwd / e5m2 grad, f32 masters + accumulation); embed/lm_head stay
+    full precision — the standard fp8 recipe.  None consults
+    ``MXNET_TPU_QUANT``."""
+    from .. import quant as _quant
+    qcfg = _quant.resolve_quant(quant)
+    qstr = "fp8" if qcfg is not None else ""
     b, l, d = batch_size, seq_len, d_model
     net = sym.Embedding(data=sym.Variable("data"), input_dim=vocab_size,
                         output_dim=d, name="embed")
@@ -89,11 +102,12 @@ def transformer_lm(vocab_size=256, num_layers=2, d_model=64, heads=4,
         with scope:
             net = transformer_block(net, b, l, d, heads, f"layer{i}",
                                     causal=causal,
-                                    attn_block_size=attn_block_size)
+                                    attn_block_size=attn_block_size,
+                                    quant=qstr)
     net = _layernorm(net, "final_ln")
-    net = sym.Reshape(data=net, shape=(b * l, d))
+    net = sym.Reshape(data=net, shape=(-1, d))
     net = sym.FullyConnected(data=net, num_hidden=vocab_size, name="lm_head")
-    label = sym.Reshape(data=sym.Variable("softmax_label"), shape=(b * l,))
+    label = sym.Reshape(data=sym.Variable("softmax_label"), shape=(-1,))
     head_kwargs = {}
     if ignore_label is not None:
         head_kwargs = dict(use_ignore=True, ignore_label=ignore_label)

@@ -7,8 +7,16 @@ and the optimizer math are all separate jnp ops over the same HBM bytes
 stack 18 — BENCH_r07.json).  This module collapses the whole update into
 ONE primitive per bucket, ``mxtpu_fused_update``:
 
-    (g, w, *state, *kind_scalars[, mult][, ok])
+    (g, w, *state[, wd_vec], *kind_scalars[, mult][, ok])
         -> (new_w, *new_state)
+
+``wd_vec`` (optional, same flat length as ``g``) carries a per-element
+effective weight decay — the per-bucket segment vector the trainer
+builds when ``wd_mult`` differs across params (gamma/beta/bias
+exclusion), which used to force the unfused fallback.  When present it
+replaces the scalar ``wd`` hyperparameter elementwise (and for adamw
+the kernel forms ``lrwd = lr_eff * wd_vec`` in place of the caller's
+pre-multiplied scalar).
 
 The scalar chain (loss-scale unscale x clip coefficient -> ``mult``,
 bias-corrected ``lr_t`` for adam, the guard verdict ``ok``) is computed
@@ -77,11 +85,16 @@ def fused_enabled() -> bool:
 # operand packing
 # ----------------------------------------------------------------------
 
-def _split_operands(args, *, kind, n_state, has_mult, has_ok):
+def _split_operands(args, *, kind, n_state, has_mult, has_ok,
+                    has_wdvec=False):
     g, w = args[0], args[1]
     i = 2
     state = tuple(args[i:i + n_state])
     i += n_state
+    wdvec = None
+    if has_wdvec:
+        wdvec = args[i]
+        i += 1
     nsc = _N_SCALARS[kind]
     scalars = tuple(args[i:i + nsc])
     i += nsc
@@ -90,7 +103,7 @@ def _split_operands(args, *, kind, n_state, has_mult, has_ok):
         mult = args[i]
         i += 1
     ok = args[i] if has_ok else None
-    return g, w, state, scalars, mult, ok
+    return g, w, state, scalars, mult, ok, wdvec
 
 
 # ----------------------------------------------------------------------
@@ -98,9 +111,14 @@ def _split_operands(args, *, kind, n_state, has_mult, has_ok):
 # ----------------------------------------------------------------------
 
 def _reference(*args, kind, momentum, beta1, beta2, epsilon, wd,
-               rescale_grad, clip_gradient, has_mult, has_ok, n_state):
-    g, w, state, scalars, mult, ok = _split_operands(
-        args, kind=kind, n_state=n_state, has_mult=has_mult, has_ok=has_ok)
+               rescale_grad, clip_gradient, has_mult, has_ok, n_state,
+               has_wdvec=False):
+    g, w, state, scalars, mult, ok, wdvec = _split_operands(
+        args, kind=kind, n_state=n_state, has_mult=has_mult, has_ok=has_ok,
+        has_wdvec=has_wdvec)
+    # the scalar wd hyperparameter, or the per-element segment vector —
+    # elementwise either way, so the op chain below is unchanged
+    wdv = wdvec if has_wdvec else wd
     if has_mult:
         g = g * mult
     # _prep_grad, verbatim
@@ -110,23 +128,27 @@ def _reference(*args, kind, momentum, beta1, beta2, epsilon, wd,
 
     if kind == "sgd":
         lr_eff = scalars[0]
-        new_w = w - lr_eff * (g + wd * w)
+        new_w = w - lr_eff * (g + wdv * w)
         new_state = ()
     elif kind == "sgd_momentum":
         lr_eff = scalars[0]
-        mom = momentum * state[0] - lr_eff * (g + wd * w)
+        mom = momentum * state[0] - lr_eff * (g + wdv * w)
         new_w = w + mom
         new_state = (mom,)
     elif kind == "adam":
         lr_t = scalars[0]
         mean, variance = state
-        g = g + wd * w
+        g = g + wdv * w
         m = beta1 * mean + (1.0 - beta1) * g
         v = beta2 * variance + (1.0 - beta2) * g * g
         new_w = w - lr_t * m / (jnp.sqrt(v) + epsilon)
         new_state = (m, v)
     elif kind == "adamw":
+        # scalar form: scalars[1] is the pre-multiplied lr*wd; vector
+        # form: scalars[1] is lr_eff and lrwd forms elementwise here
         lr_t, lrwd = scalars
+        if has_wdvec:
+            lrwd = lrwd * wdvec
         mean, variance = state
         m = beta1 * mean + (1.0 - beta1) * g
         v = beta2 * variance + (1.0 - beta2) * g * g
@@ -163,16 +185,22 @@ def _materialized_reference(*args, **params):
     unfoldable under NaN semantics) so WhileLoopSimplifier cannot
     inline the body back into the caller.
     """
-    g, w, state, scalars, mult, ok = _split_operands(
+    g, w, state, scalars, mult, ok, wdvec = _split_operands(
         args, kind=params["kind"], n_state=params["n_state"],
-        has_mult=params["has_mult"], has_ok=params["has_ok"])
+        has_mult=params["has_mult"], has_ok=params["has_ok"],
+        has_wdvec=params.get("has_wdvec", False))
     trip = jnp.where(scalars[0] == scalars[0], jnp.int32(1), jnp.int32(2))
 
     def cond(carry):
         return carry[0] < trip
 
     def body(carry):
-        outs = _reference(g, carry[1], *carry[2:], *scalars,
+        # wdvec is input-only (never rewritten) so it is captured, not
+        # carried — but it must sit between state and scalars to match
+        # the operand protocol _reference re-splits
+        outs = _reference(g, carry[1], *carry[2:],
+                          *(() if wdvec is None else (wdvec,)),
+                          *scalars,
                           *(() if mult is None else (mult,)),
                           *(() if ok is None else (ok,)), **params)
         return (carry[0] + jnp.int32(1), *outs)
@@ -186,7 +214,8 @@ def _materialized_reference(*args, **params):
 # ----------------------------------------------------------------------
 
 def _make_kernel(*, kind, momentum, beta1, beta2, epsilon, wd,
-                 rescale_grad, clip_gradient, has_mult, has_ok, n_state):
+                 rescale_grad, clip_gradient, has_mult, has_ok, n_state,
+                 has_wdvec=False):
     nsc = _N_SCALARS[kind]
     n_out = 1 + n_state
     # pre-cast the trace-time python-float hyperparameters to numpy-f32
@@ -212,6 +241,10 @@ def _make_kernel(*, kind, momentum, beta1, beta2, epsilon, wd,
         i = 2
         state_refs = refs[i:i + n_state]
         i += n_state
+        wdv_ref = None
+        if has_wdvec:
+            wdv_ref = refs[i]
+            i += 1
         sc_refs = refs[i:i + nsc]
         i += nsc
         mult_ref = None
@@ -223,6 +256,7 @@ def _make_kernel(*, kind, momentum, beta1, beta2, epsilon, wd,
 
         g = g_ref[...]
         w = w_ref[...]
+        wdv = wdv_ref[...] if has_wdvec else wd_c
         if has_mult:
             g = g * mult_ref[0, 0]
         g = g * rescale_c
@@ -230,11 +264,11 @@ def _make_kernel(*, kind, momentum, beta1, beta2, epsilon, wd,
             g = jnp.clip(g, clip_lo, clip_hi)
 
         if kind == "sgd":
-            new_w = w - sc_refs[0][0, 0] * (g + wd_c * w)
+            new_w = w - sc_refs[0][0, 0] * (g + wdv * w)
             new_state = ()
         elif kind == "sgd_momentum":
             st = state_refs[0][...]
-            mom = momentum_c * st - sc_refs[0][0, 0] * (g + wd_c * w)
+            mom = momentum_c * st - sc_refs[0][0, 0] * (g + wdv * w)
             new_w = w + mom
             new_state = (mom,)
         else:  # adam / adamw
@@ -242,14 +276,16 @@ def _make_kernel(*, kind, momentum, beta1, beta2, epsilon, wd,
             mean = state_refs[0][...]
             variance = state_refs[1][...]
             if kind == "adam":
-                g = g + wd_c * w
+                g = g + wdv * w
             m = b1_c * mean + omb1_c * g
             v = b2_c * variance + omb2_c * g * g
             update = lr_t * m / (jnp.sqrt(v) + eps_c)
             if kind == "adam":
                 new_w = w - update
             else:
-                new_w = w - update - sc_refs[1][0, 0] * w
+                lrwd = (sc_refs[1][0, 0] * wdv if has_wdvec
+                        else sc_refs[1][0, 0])
+                new_w = w - update - lrwd * w
             new_state = (m, v)
 
         if has_ok:
@@ -270,8 +306,10 @@ def _pallas_apply(args, params, interpret):
     kind = params["kind"]
     n_state = params["n_state"]
     has_mult, has_ok = params["has_mult"], params["has_ok"]
-    g, w, state, scalars, mult, ok = _split_operands(
-        args, kind=kind, n_state=n_state, has_mult=has_mult, has_ok=has_ok)
+    has_wdvec = params.get("has_wdvec", False)
+    g, w, state, scalars, mult, ok, wdvec = _split_operands(
+        args, kind=kind, n_state=n_state, has_mult=has_mult, has_ok=has_ok,
+        has_wdvec=has_wdvec)
     n = g.shape[0]
     n_out = 1 + n_state
 
@@ -291,6 +329,11 @@ def _pallas_apply(args, params, interpret):
         return a.reshape(rows, _LANES)
 
     arrays = [as_tiles(g), as_tiles(w)] + [as_tiles(s) for s in state]
+    if has_wdvec:
+        # input-only tile operand (never aliased to an output; the
+        # {1+k: k} aliasing below only covers w and the state operands,
+        # whose indices precede it)
+        arrays.append(as_tiles(wdvec))
     smalls = [jnp.asarray(s, jnp.float32).reshape(1, 1)
               for s in scalars]
     if has_mult:
@@ -346,8 +389,8 @@ _mlir.register_lowering(
 
 
 def fused_update(g, w, state=(), scalars=(), *, kind, mult=None, ok=None,
-                 momentum=0.0, beta1=0.0, beta2=0.0, epsilon=0.0, wd=0.0,
-                 rescale_grad=1.0, clip_gradient=None):
+                 wd_vec=None, momentum=0.0, beta1=0.0, beta2=0.0,
+                 epsilon=0.0, wd=0.0, rescale_grad=1.0, clip_gradient=None):
     """Bind one fused update over a flat f32 bucket.
 
     Returns ``(new_w, *new_state)``.  ``scalars`` is the kind's combined
@@ -355,7 +398,11 @@ def fused_update(g, w, state=(), scalars=(), *, kind, mult=None, ok=None,
     ``(lr_eff,)`` for sgd/sgd_momentum, ``(lr_t,)`` for adam,
     ``(lr_t, lr*wd)`` for adamw.  ``mult`` (optional f32 scalar) is the
     combined loss-scale-unscale x clip coefficient; ``ok`` (optional
-    bool scalar) gates the whole update to a bitwise no-op.
+    bool scalar) gates the whole update to a bitwise no-op.  ``wd_vec``
+    (optional flat f32, same length as ``g``) is the per-element
+    effective weight decay (``wd * wd_mult`` per param segment); when
+    present it replaces the scalar ``wd``, and for adamw ``scalars``
+    must be ``(lr_t, lr_eff)`` — the kernel forms ``lr_eff * wd_vec``.
     """
     if kind not in SUPPORTED_KINDS:
         raise ValueError(f"unsupported fused kind {kind!r}")
@@ -365,8 +412,13 @@ def fused_update(g, w, state=(), scalars=(), *, kind, mult=None, ok=None,
     if len(scalars) != _N_SCALARS[kind]:
         raise ValueError(f"{kind} expects {_N_SCALARS[kind]} scalar "
                          f"operands, got {len(scalars)}")
-    operands = [g, w, *state,
-                *(jnp.asarray(s, jnp.float32) for s in scalars)]
+    if wd_vec is not None and wd_vec.shape != g.shape:
+        raise ValueError(f"wd_vec shape {wd_vec.shape} != bucket shape "
+                         f"{g.shape}")
+    operands = [g, w, *state]
+    if wd_vec is not None:
+        operands.append(wd_vec)
+    operands.extend(jnp.asarray(s, jnp.float32) for s in scalars)
     if mult is not None:
         operands.append(jnp.asarray(mult, jnp.float32))
     if ok is not None:
@@ -378,29 +430,31 @@ def fused_update(g, w, state=(), scalars=(), *, kind, mult=None, ok=None,
         clip_gradient=(None if clip_gradient is None
                        else float(clip_gradient)),
         has_mult=mult is not None, has_ok=ok is not None,
-        n_state=len(state)))
+        has_wdvec=wd_vec is not None, n_state=len(state)))
 
 
 def reference_update(g, w, state=(), scalars=(), *, kind, mult=None,
-                     ok=None, **hyper):
+                     ok=None, wd_vec=None, **hyper):
     """The jnp reference, callable directly (tests)."""
-    kw = _norm_hyper(kind, len(state), mult, ok, hyper)
-    operands = _pack(g, w, state, scalars, mult, ok)
+    kw = _norm_hyper(kind, len(state), mult, ok, wd_vec, hyper)
+    operands = _pack(g, w, state, scalars, mult, ok, wd_vec)
     return tuple(_reference(*operands, **kw))
 
 
 def pallas_update(g, w, state=(), scalars=(), *, kind, mult=None, ok=None,
-                  interpret=True, **hyper):
+                  wd_vec=None, interpret=True, **hyper):
     """The Pallas kernel, callable directly; ``interpret=True`` runs it
     on CPU (tests pin it bitwise against :func:`reference_update`)."""
-    kw = _norm_hyper(kind, len(state), mult, ok, hyper)
-    operands = _pack(g, w, state, scalars, mult, ok)
+    kw = _norm_hyper(kind, len(state), mult, ok, wd_vec, hyper)
+    operands = _pack(g, w, state, scalars, mult, ok, wd_vec)
     return tuple(_pallas_apply(operands, kw, interpret=interpret))
 
 
-def _pack(g, w, state, scalars, mult, ok):
-    operands = [g, w, *state,
-                *(jnp.asarray(s, jnp.float32) for s in scalars)]
+def _pack(g, w, state, scalars, mult, ok, wd_vec=None):
+    operands = [g, w, *state]
+    if wd_vec is not None:
+        operands.append(wd_vec)
+    operands.extend(jnp.asarray(s, jnp.float32) for s in scalars)
     if mult is not None:
         operands.append(jnp.asarray(mult, jnp.float32))
     if ok is not None:
@@ -408,12 +462,12 @@ def _pack(g, w, state, scalars, mult, ok):
     return operands
 
 
-def _norm_hyper(kind, n_state, mult, ok, hyper):
+def _norm_hyper(kind, n_state, mult, ok, wd_vec, hyper):
     kw = dict(kind=kind, momentum=0.0, beta1=0.0, beta2=0.0, epsilon=0.0,
               wd=0.0, rescale_grad=1.0, clip_gradient=None)
     kw.update(hyper)
     kw.update(has_mult=mult is not None, has_ok=ok is not None,
-              n_state=n_state)
+              has_wdvec=wd_vec is not None, n_state=n_state)
     return kw
 
 

@@ -139,13 +139,21 @@ register_op(OpDef(
 # ---------------------------------------------------------------------------
 
 def _fc_fwd(ctx, params, data, weight, bias=None):
+    from .. import quant as _quant
     # reference flattens trailing dims: (N, ...) -> (N, K)  (fully_connected-inl.h:70)
     x = data.reshape((data.shape[0], -1))
-    # mixed precision: the weight dtype is the compute dtype (bf16 under
-    # the AMP policy) — cast the activation at the MXU edge
-    if x.dtype != weight.dtype:
-        x = x.astype(weight.dtype)
-    out = jnp.dot(x, weight.T)          # out = dot(data, wmat.T()) :76-80
+    if params.get("quant") == "fp8":
+        # block-scaled fp8 matmul (e4m3 fwd / e5m2 grad, f32 accumulate);
+        # `weight` stays the f32/bf16 master — quantization is in-graph
+        # on the forward/backward edges only (quant.fp8_linear)
+        cfg = _quant.resolve_quant("fp8")
+        out = _quant.fp8_linear(x, weight, cfg).astype(weight.dtype)
+    else:
+        # mixed precision: the weight dtype is the compute dtype (bf16
+        # under the AMP policy) — cast the activation at the MXU edge
+        if x.dtype != weight.dtype:
+            x = x.astype(weight.dtype)
+        out = jnp.dot(x, weight.T)      # out = dot(data, wmat.T()) :76-80
     if bias is not None:
         out = out + bias.astype(out.dtype)
     return out
@@ -174,9 +182,11 @@ register_op(OpDef(
     params={
         "num_hidden": OpParam("num_hidden", "int", required=True),
         "no_bias": OpParam("no_bias", "bool", default=False),
+        "quant": OpParam("quant", "str", default="", enum=("", "fp8")),
     },
     infer_shape=_fc_shape,
-    doc="Linear layer: out = data @ weight.T + bias (MXU matmul).",
+    doc="Linear layer: out = data @ weight.T + bias (MXU matmul); "
+        "quant='fp8' routes through the block-scaled fp8 path.",
 ))
 
 

@@ -24,9 +24,14 @@ programs are dispatched per dtype class.  Dispatch is async (JAX returns
 futures), so early buckets reduce while later ones are still being
 assembled — compute/comm overlap without an engine thread.
 
-Optional quantized reduction (``compression='int8' | 'bf16'``) implements
-EQuARX-style scale-per-bucket quantize → all-reduce → dequantize inside
-the same fused program; see :func:`psum_compressed`.
+Optional quantized reduction (``compression='int8' | 'bf16' | 'fp8'``)
+implements EQuARX-style quantize → all-reduce → dequantize inside the
+same fused program, with one f32 scale per 128-element *block* (not per
+buffer) so a single outlier only poisons its own block, and optional
+**error feedback**: callers that carry a persistent f32 residual get
+the per-step quantization error accumulated into the next step's input,
+so compression bias vanishes across steps instead of biasing SGD; see
+:func:`psum_compressed`.
 """
 from __future__ import annotations
 
@@ -42,6 +47,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..base import MXNetError
+from .. import quant
 from .._compat import shard_map
 
 __all__ = ["allreduce_sum", "allreduce_mean", "distinct_devices",
@@ -49,7 +55,7 @@ __all__ = ["allreduce_sum", "allreduce_mean", "distinct_devices",
            "DEFAULT_BUCKET_BYTES", "COMPRESSIONS", "plan_buckets"]
 
 DEFAULT_BUCKET_BYTES = 4 << 20  # ~4 MiB, the classic DDP default
-COMPRESSIONS = (None, "int8", "bf16")
+COMPRESSIONS = (None, "int8", "bf16", "fp8")
 
 
 def check_compression(compression: Optional[str]) -> Optional[str]:
@@ -102,6 +108,11 @@ class CollectiveStats:
     def total_bytes(self) -> int:
         return sum(r["nbytes"] for r in self.records)
 
+    @property
+    def total_wire_bytes(self) -> int:
+        """Bytes actually crossing the interconnect (compressed width)."""
+        return sum(r.get("wire_nbytes", r["nbytes"]) for r in self.records)
+
     def __repr__(self):
         return f"CollectiveStats(count={self.count}, bytes={self.total_bytes})"
 
@@ -132,46 +143,99 @@ def _emit(rec: dict) -> None:
 # quantized psum — usable standalone inside any shard_map body (the
 # ShardedTrainer grad path imports it) and by the bucket programs below.
 
+def _block_view(flat: jax.Array, block: int) -> jax.Array:
+    """Pad a flat f32 vector to a whole number of scale blocks and view
+    it as ``[nblocks, block]``."""
+    n = flat.size
+    nb = -(-n // block)
+    pad = nb * block - n
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    return flat.reshape(nb, block)
+
+
 def psum_compressed(x: jax.Array, axis_name: str,
-                    compression: Optional[str] = None) -> jax.Array:
+                    compression: Optional[str] = None, *,
+                    block: Optional[int] = None,
+                    residual: Optional[jax.Array] = None):
     """All-reduce-sum ``x`` over ``axis_name``, optionally through a
     quantized wire format.
 
-    ``'int8'``: scale-per-buffer symmetric quantization — every shard
-    quantizes with the same global scale (``pmax`` of the per-shard
-    absmax), the reduce runs on int32 lanes (exact for any realistic
-    device count), then one dequantize multiply.  4x (f32) / 2x (bf16)
-    less wire traffic at ~1/254 relative rounding error per element.
+    Lossy formats quantize with one f32 scale per ``block`` contiguous
+    elements (default ``quant.default_block_size()``, 128); every shard
+    shares the same per-block scale (``pmax`` of the per-shard block
+    absmax) so the reduction stays a plain sum on the quantized lanes:
+
+    ``'int8'``: symmetric round-to-nearest onto [-127, 127]; the reduce
+    runs on int32 lanes (exact for any realistic device count), then one
+    dequantize multiply.  4x (f32) less wire traffic.
+
+    ``'fp8'``: cast onto the e4m3 grid with the block absmax pinned to
+    the format max (448), psum on f32 lanes — the 1-byte payload is what
+    an EQuARX-style in-XLA reduce puts on the ICI links; accumulation is
+    exact, matching int8's int32 lanes.
 
     ``'bf16'``: cast → psum → cast back; exact for values already bf16.
+
+    **Error feedback**: pass ``residual`` (flat f32, ``x.size`` elems,
+    per-shard) to compress ``x + residual`` instead of ``x`` and get
+    ``(sum, new_residual)`` back, where ``new_residual`` is exactly the
+    quantization error this shard just committed.  Carried across steps
+    it cancels compression bias instead of letting it accumulate in the
+    weights (Seide et al. 1-bit SGD; EQuARX).
 
     Non-float inputs ignore ``compression`` (quantizing indices or bool
     masks is never right) and take the plain psum.
     """
     check_compression(compression)
     if compression is None or not jnp.issubdtype(x.dtype, jnp.floating):
-        return jax.lax.psum(x, axis_name)
-    if compression == "bf16":
+        red = jax.lax.psum(x, axis_name)
+        return red if residual is None else (red, residual)
+    if compression == "bf16" and residual is None:
         return jax.lax.psum(x.astype(jnp.bfloat16), axis_name).astype(x.dtype)
-    # int8: one scale per buffer, shared across shards via pmax
-    xf = x.astype(jnp.float32)
-    absmax = jax.lax.pmax(jnp.max(jnp.abs(xf)), axis_name)
-    scale = jnp.maximum(absmax, jnp.float32(1e-30)) / jnp.float32(127.0)
-    q = jnp.clip(jnp.round(xf / scale), -127.0, 127.0).astype(jnp.int8)
-    s = jax.lax.psum(q.astype(jnp.int32), axis_name)
-    return (s.astype(jnp.float32) * scale).astype(x.dtype)
+
+    xf = x.astype(jnp.float32).ravel()
+    y = xf if residual is None else xf + residual.reshape(xf.shape)
+
+    if compression == "bf16":
+        q = y.astype(jnp.bfloat16)
+        deq = q.astype(jnp.float32)
+        red = jax.lax.psum(q, axis_name).astype(jnp.float32)
+    else:
+        if block is None:
+            block = quant.default_block_size()
+        yb = _block_view(y, block)
+        absmax = jax.lax.pmax(
+            jnp.max(jnp.abs(yb), axis=1, keepdims=True), axis_name)
+        if compression == "int8":
+            scale = jnp.maximum(absmax, jnp.float32(1e-30)) / jnp.float32(127.0)
+            q = jnp.clip(jnp.round(yb / scale), -127.0, 127.0).astype(jnp.int8)
+            s = jax.lax.psum(q.astype(jnp.int32), axis_name)
+        else:  # fp8: e4m3 payload, exact f32 accumulation lanes
+            scale = (jnp.maximum(absmax, jnp.float32(1e-30))
+                     / jnp.float32(quant.FP8_MAX["e4m3"]))
+            q = (yb / scale).astype(jnp.float8_e4m3fn)
+            s = jax.lax.psum(q.astype(jnp.float32), axis_name)
+        deq = (q.astype(jnp.float32) * scale).reshape(-1)[:y.size]
+        red = (s.astype(jnp.float32) * scale).reshape(-1)[:y.size]
+
+    out = red.reshape(x.shape).astype(x.dtype)
+    if residual is None:
+        return out
+    return out, (y - deq).reshape(residual.shape)
 
 
 # ---------------------------------------------------------------------------
 # fused bucket programs
 
 @functools.lru_cache(maxsize=None)
-def _allreduce_prog(devices, mean: bool, compression: Optional[str]):
+def _allreduce_prog(devices, mean: bool, compression: Optional[str],
+                    block: int):
     mesh = Mesh(np.array(devices), ("dev",))
     n = len(devices)
 
     def body(x):
-        s = psum_compressed(x, "dev", compression)
+        s = psum_compressed(x, "dev", compression, block=block)
         return s / n if mean else s
 
     return jax.jit(shard_map(body, mesh=mesh, in_specs=P("dev"),
@@ -183,7 +247,10 @@ def _reduce_stacked(arrays: List[jax.Array], devices, mean: bool,
     """One fused all-reduce over N per-device arrays of identical shape.
     Returns the reduced value per device, input order."""
     shape = tuple(arrays[0].shape)
-    prog, mesh = _allreduce_prog(devices, mean, compression)
+    # the block size is part of the cached program's identity: an env
+    # override between calls must not be served a stale trace
+    prog, mesh = _allreduce_prog(devices, mean, compression,
+                                 quant.default_block_size())
     shards = [a[None] for a in arrays]  # (1, *shape), stays on its device
     global_arr = jax.make_array_from_single_device_arrays(
         (len(arrays),) + shape, NamedSharding(mesh, P("dev")), shards)
@@ -289,7 +356,11 @@ def _allreduce_bucketed(groups: List[List[jax.Array]], mean: bool,
                 per_dev.append(segs[0] if len(segs) == 1
                                else jnp.concatenate(segs))
             reduced = _reduce_stacked(per_dev, devices, mean, compression)
+            wire_item = quant.wire_itemsize(
+                compression if jnp.issubdtype(dtype, jnp.floating) else None,
+                dtype.itemsize)
             _emit({"nbytes": int(per_dev[0].size) * dtype.itemsize,
+                   "wire_nbytes": int(per_dev[0].size) * wire_item,
                    "num_pieces": len(bucket),
                    "tensor_indices": [sized[pi][0] for pi, _, _ in bucket],
                    "dtype": str(dtype), "compression": compression,
@@ -346,6 +417,7 @@ def _allreduce(arrays, mean: bool, priorities=None,
             if mean:
                 acc = acc / len(g)
             _emit({"nbytes": int(acc.size) * acc.dtype.itemsize,
+                   "wire_nbytes": int(acc.size) * acc.dtype.itemsize,
                    "num_pieces": 1, "tensor_indices": [gi],
                    "dtype": str(acc.dtype), "compression": None,
                    "mean": mean, "kind": "tree"})

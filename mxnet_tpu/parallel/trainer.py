@@ -116,6 +116,15 @@ def _key_from_meta(meta: Dict[str, Any]):
     return jnp.asarray(data)
 
 
+def _quant_block_key(compression: Optional[str]) -> Optional[int]:
+    """Scale-block size for the program cache key — it changes the
+    traced quantization layout, but only for the block-scaled formats."""
+    if compression in ("int8", "fp8"):
+        from .. import quant
+        return quant.default_block_size()
+    return None
+
+
 class ShardedTrainer:
     """Compiled data/tensor-parallel trainer for a Symbol.
 
@@ -142,6 +151,7 @@ class ShardedTrainer:
                  grad_accum: int = 1,
                  grad_compression: Optional[str] = None,
                  grad_bucket_bytes: Optional[int] = None,
+                 error_feedback: Optional[bool] = None,
                  fused_update: Optional[bool] = None,
                  guard: Optional[bool] = None,
                  clip_global_norm: Optional[float] = None,
@@ -211,6 +221,28 @@ class ShardedTrainer:
         if grad_compression is not None and self.data_axis is None:
             raise MXNetError("grad_compression needs a data axis to "
                              "reduce over; this mesh has none")
+        # error feedback: carry each bucket's quantization error in a
+        # persistent per-shard f32 residual (opt_state "efres:<i>") and
+        # fold it into the next step's pre-quantization input, so the
+        # compression bias cancels across steps instead of accumulating
+        # in the weights.  Defaults ON for the lossy formats (int8/fp8;
+        # MXNET_TPU_QUANT_EF overrides).  grad_accum>1 reduces inside
+        # the microbatch scan, where a persistent residual has no home.
+        from .. import quant as _quant
+        if error_feedback is None:
+            self.error_feedback = (
+                self.grad_compression is not None and self.grad_accum == 1
+                and _quant.error_feedback_default(self.grad_compression))
+        else:
+            if error_feedback and self.grad_compression is None:
+                raise MXNetError("error_feedback=True needs a lossy "
+                                 "grad_compression to feed back from")
+            if error_feedback and self.grad_accum > 1:
+                raise MXNetError("error_feedback does not compose with "
+                                 "grad_accum > 1 (reduction runs inside "
+                                 "the microbatch scan)")
+            self.error_feedback = bool(error_feedback)
+        self._ef_keys: List[str] = []
         # single-pass fused optimizer update (ops/fused_update.py): one
         # primitive per flat grad bucket replaces the unfused jnp chain
         # (loss-scale unscale x clip x guard gating x optimizer step),
@@ -234,6 +266,13 @@ class ShardedTrainer:
         if clip_global_norm is None:
             clip_global_norm = getattr(self.optimizer, "clip_global_norm",
                                        None)
+        # fp8 compute squeezes the backward's dynamic range from both
+        # ends (e5m2 grads underflow early, e4m3 saturates at 448) —
+        # default dynamic loss scaling ON when the symbol requests the
+        # fp8 matmul path and the user set no explicit scale policy
+        if loss_scale is None and guard is not False \
+                and _quant.symbol_uses_fp8(symbol):
+            loss_scale = "dynamic"
         # legacy-spelling parity: Optimizer(skip_nonfinite=True) turns
         # the guard on here exactly as it does on Module/FeedForward
         if guard is None and getattr(self.optimizer, "skip_nonfinite",
@@ -442,6 +481,39 @@ class ShardedTrainer:
                     lambda z, _n=n: self._global_put(
                         z, NamedSharding(self.mesh, self._zero_specs[_n])),
                     opt.state_zeros_like(template))
+        if self._fused and not self._fused_wd_uniform:
+            # per-bucket wd segment vectors (satellite of ROADMAP item
+            # 4): each element holds its param's effective wd, laid out
+            # in bucket order, so the kernel's wd multiply stays one
+            # elementwise op.  Static config, not training state — they
+            # ride opt_state for donation/placement but are excluded
+            # from checkpoints (_state_arrays) so a restore never
+            # resurrects a stale wd schedule.
+            rep = replicated(self.mesh)
+            for i, bucket in enumerate(self._fused_plan.buckets):
+                vec = np.empty(sum(s1 - s0 for _, s0, s1 in bucket),
+                               np.float32)
+                off = 0
+                for n, s0, s1 in bucket:
+                    vec[off:off + (s1 - s0)] = np.float32(
+                        opt.wd * self._wd_mult[n])
+                    off += s1 - s0
+                opt_state[f"fusedwd:{i}"] = self._global_put(vec, rep)
+        self._ef_keys = []
+        if self.error_feedback:
+            # one persistent f32 residual per grad bucket, sharded over
+            # the data axis (each shard carries ITS OWN quantization
+            # error).  Flat 1-D so a cross-mesh checkpoint restore can
+            # pad/slice it mechanically (checkpoint/reader._adapt_shape)
+            # — a sliced residual loses at most one step's sub-quantum
+            # correction, never correctness.
+            ndata = self.mesh.shape[self.data_axis]
+            ef_sh = NamedSharding(self.mesh, P(self.data_axis))
+            for i, blen in enumerate(self._grad_bucket_lens(params)):
+                key = f"efres:{i}"
+                opt_state[key] = self._global_put(
+                    np.zeros(ndata * blen, np.float32), ef_sh)
+                self._ef_keys.append(key)
 
         self._params, self._aux, self._opt_state = params, aux, opt_state
         if self._resil is not None:
@@ -526,9 +598,24 @@ class ShardedTrainer:
             why.append("zero-size params")
         if len({float(v) for v in self._lr_mult.values()}) > 1:
             why.append("per-param lr_mult")
-        if len({float(self.optimizer.wd * v)
-                for v in self._wd_mult.values()}) > 1:
-            why.append("per-param effective wd")
+        # per-param effective wd (gamma/beta/bias exclusion) is fused-
+        # eligible: a non-uniform layout rides a per-bucket wd segment
+        # vector operand into the kernel (opt_state "fusedwd:<i>")
+        self._fused_wd_uniform = len(
+            {float(self.optimizer.wd * v)
+             for v in self._wd_mult.values()}) <= 1
+        if kind == "adam" and any(
+                float(self.optimizer.wd * v) != 0.0
+                for v in self._wd_mult.values()):
+            # adam FOLDS wd into the gradient (g + wd*w) and that fold
+            # feeds both moments; LLVM's FMA contraction of it is
+            # context-dependent, so the fused twin is 1 ulp off the
+            # inline unfused step — no bitwise twin exists.  (adamw's
+            # DECOUPLED wd never touches the grad and stays bitwise;
+            # sgd's fold has a single consumer and contracts the same
+            # way in both contexts.)
+            why.append("adam with weight decay (folded wd has no "
+                       "bitwise fused twin; use adamw)")
         if why:
             if req:
                 raise MXNetError("fused_update=True but this "
@@ -553,8 +640,31 @@ class ShardedTrainer:
                 total += int(np.prod(shard)) * leaf.dtype.itemsize
         return total
 
+    def _grad_bucket_lens(self, params) -> List[int]:
+        """Element count of every grad bucket ``reduce_grads`` will emit,
+        in dispatch order — the bind-time mirror that sizes the error-
+        feedback residuals.  Must iterate exactly like ``reduce_grads``
+        (reversed param order, dtype classes in first-seen order, greedy
+        ``plan_buckets`` fill); grad dtype == master param dtype."""
+        from .collectives import plan_buckets
+        order = [n for n in reversed(self._param_names)]
+        by_dtype: Dict[Any, List[str]] = {}
+        for n in order:
+            by_dtype.setdefault(jnp.dtype(params[n].dtype), []).append(n)
+        lens: List[int] = []
+        for dtype, names in by_dtype.items():
+            counts = [int(np.prod(params[n].shape, dtype=np.int64))
+                      for n in names]
+            counts = [c for c in counts if c > 0]
+            if not counts:
+                continue
+            plan = plan_buckets(counts, dtype.itemsize,
+                                self.grad_bucket_bytes)
+            lens.extend(sum(s1 - s0 for _, s0, s1 in b) for b in plan)
+        return lens
+
     def _explicit_comm_grads(self, base, resil: bool = False,
-                             bucket_out: bool = False):
+                             bucket_out: bool = False, ef: bool = False):
         """Wrap the grad computation in a manual shard_map region over the
         data axis: per-shard backward, then explicit bucketed (and
         optionally quantized) psums of the gradients — the comm path this
@@ -581,6 +691,13 @@ class ShardedTrainer:
         being scattered back to per-tensor grads: the fused kernel
         consumes them directly, so the scatter pass (one extra
         read+write of every bucket) disappears entirely.
+
+        With ``ef`` the body additionally takes the list of per-shard
+        error-feedback residuals (one flat f32 per bucket, in dispatch
+        order) and returns the updated residuals as its last output:
+        each bucket quantizes ``grads + residual`` and the residual
+        becomes exactly the quantization error just committed
+        (collectives.psum_compressed).
         """
         from .._compat import shard_map
         from .collectives import plan_buckets, psum_compressed
@@ -589,13 +706,15 @@ class ShardedTrainer:
         bucket_bytes = self.grad_bucket_bytes
         param_names = list(self._param_names)
 
-        def reduce_grads(grads):
+        def reduce_grads(grads, ef_res=None):
             order = [n for n in reversed(param_names) if n in grads]
             by_dtype: Dict[Any, List[str]] = {}
             for n in order:
                 by_dtype.setdefault(jnp.dtype(grads[n].dtype), []).append(n)
             out = dict(grads)
             flat_buckets: List[jax.Array] = []
+            new_ef: List[jax.Array] = []
+            bidx = 0
             sq = jnp.float32(0.0)
             for dtype, names in by_dtype.items():
                 names = [n for n in names
@@ -610,7 +729,13 @@ class ShardedTrainer:
                     segs = [grads[names[pi]].ravel()[s0:s1]
                             for pi, s0, s1 in bucket]
                     flat = segs[0] if len(segs) == 1 else jnp.concatenate(segs)
-                    red = psum_compressed(flat, daxis, comp)
+                    if ef_res is not None:
+                        red, nres = psum_compressed(
+                            flat, daxis, comp, residual=ef_res[bidx])
+                        new_ef.append(nres)
+                    else:
+                        red = psum_compressed(flat, daxis, comp)
+                    bidx += 1
                     if resil:
                         # fused guard stat on the reduced flat bucket
                         sq = sq + jnp.sum(jnp.square(
@@ -629,34 +754,41 @@ class ShardedTrainer:
                     ps = pieces[n]
                     flat = ps[0] if len(ps) == 1 else jnp.concatenate(ps)
                     out[n] = flat.reshape(grads[n].shape)
-            if bucket_out:
-                return flat_buckets, sq
-            return out, sq
+            res = (flat_buckets, sq) if bucket_out else (out, sq)
+            return res + ((new_ef,) if ef_res is not None else ())
 
+        # the residual lists ride in/out as pytrees; P(data_axis) as a
+        # pytree-prefix spec shards every flat residual over data — each
+        # shard sees/updates only ITS OWN (bucket_len,) error slice
+        ef_spec = (P(self.data_axis),) if ef else ()
         if resil:
-            def body(params, aux, batch, rng, scale):
+            def body(params, aux, batch, rng, scale, *ef_res):
                 rng = jax.random.fold_in(rng, jax.lax.axis_index(daxis))
                 grads, heads, auxu = base(params, aux, batch, rng, scale)
-                grads, sq = reduce_grads(grads)
+                red = reduce_grads(grads, *ef_res)
                 auxu = {k: jax.lax.pmean(v, daxis) for k, v in auxu.items()}
-                return grads, heads, auxu, sq
+                return (red[0], heads, auxu, red[1]) + tuple(red[2:])
 
             kwargs = dict(mesh=self.mesh,
-                          in_specs=(P(), P(), P(self.data_axis), P(), P()),
-                          out_specs=(P(), P(self.data_axis), P(), P()))
+                          in_specs=(P(), P(), P(self.data_axis), P(), P())
+                          + ef_spec,
+                          out_specs=(P(), P(self.data_axis), P(), P())
+                          + ef_spec)
         else:
-            def body(params, aux, batch, rng):
+            def body(params, aux, batch, rng, *ef_res):
                 # distinct per-shard stream (dropout etc.); GSPMD gets the
                 # same effect from per-example positions in the global batch
                 rng = jax.random.fold_in(rng, jax.lax.axis_index(daxis))
                 grads, heads, auxu = base(params, aux, batch, rng)
-                grads, _ = reduce_grads(grads)
+                red = reduce_grads(grads, *ef_res)
                 auxu = {k: jax.lax.pmean(v, daxis) for k, v in auxu.items()}
-                return grads, heads, auxu
+                return (red[0], heads, auxu) + tuple(red[2:])
 
             kwargs = dict(mesh=self.mesh,
-                          in_specs=(P(), P(), P(self.data_axis), P()),
-                          out_specs=(P(), P(self.data_axis), P()))
+                          in_specs=(P(), P(), P(self.data_axis), P())
+                          + ef_spec,
+                          out_specs=(P(), P(self.data_axis), P())
+                          + ef_spec)
         try:
             return shard_map(body, check_vma=False, **kwargs)
         except TypeError:
@@ -681,9 +813,13 @@ class ShardedTrainer:
             fused_plan = self._fused_plan
             fused_kind = self._fused_kind
             n_buckets = len(fused_plan.buckets)
-            # the gate proved these uniform across params
+            # the gate proved lr_mult uniform across params; wd is either
+            # uniform (scalar into the kernel) or rides the per-bucket
+            # "fusedwd:<i>" segment vectors built at bind
             lr_common = float(next(iter(lr_mult.values())))
-            wd_common = float(base_wd * next(iter(wd_mult.values())))
+            wd_uniform = self._fused_wd_uniform
+            wd_common = (float(base_wd * next(iter(wd_mult.values())))
+                         if wd_uniform else 0.0)
             f_momentum = float(getattr(opt, "momentum", 0.0) or 0.0)
             f_b1 = float(getattr(opt, "beta1", 0.0) or 0.0)
             f_b2 = float(getattr(opt, "beta2", 0.0) or 0.0)
@@ -753,10 +889,12 @@ class ShardedTrainer:
         # the fused kernel as-is; under accum > 1 grads must still sum
         # per-tensor across the scan, so the fused path gathers them
         explicit_fused = explicit and fused and accum == 1
+        ef = bool(self.error_feedback and explicit and accum == 1)
+        ef_keys = list(self._ef_keys) if ef else []
         if explicit:
             _grads_and_heads = self._explicit_comm_grads(
                 _grads_and_heads, resil=resil is not None,
-                bucket_out=explicit_fused)
+                bucket_out=explicit_fused, ef=ef)
 
         if fused:
             def _fused_apply(params, grads, opt_state, lr, t, mult, ok):
@@ -774,8 +912,11 @@ class ShardedTrainer:
                     tf = jnp.asarray(t, dtype=jnp.float32)
                     lr_t = (lr_eff * jnp.sqrt(1.0 - f_b2 ** tf)
                             / (1.0 - f_b1 ** tf))
+                    # with a wd segment vector the kernel forms lrwd =
+                    # lr_eff * wdvec elementwise; the scalar stays lr_eff
                     scalars = ((lr_t,) if fused_kind == "adam"
-                               else (lr_t, lr_eff * wd_common))
+                               else (lr_t, lr_eff * wd_common)
+                               if wd_uniform else (lr_t, lr_eff))
                 if isinstance(grads, dict):
                     buckets = [fused_plan.gather(grads, i)
                                for i in range(n_buckets)]
@@ -795,7 +936,9 @@ class ShardedTrainer:
                         mult=mult, ok=ok, momentum=f_momentum,
                         beta1=f_b1, beta2=f_b2, epsilon=f_eps,
                         wd=wd_common, rescale_grad=self._rescale_grad,
-                        clip_gradient=f_clip)
+                        clip_gradient=f_clip,
+                        wd_vec=(None if wd_uniform
+                                else opt_state[f"fusedwd:{i}"]))
                     new_w_buckets.append(res[0])
                     new_opt[f"fused:{i}"] = jax.tree_util.tree_unflatten(
                         treedef, list(res[1:]))
@@ -846,6 +989,7 @@ class ShardedTrainer:
             rng = jax.random.fold_in(base_key, t)
             scale_args = ((gstate["scale"],) if resil is not None else ())
             sq = None
+            new_ef = None
 
             if accum > 1:
                 # [B, ...] -> [k, B/k, ...]; grads sum across the scan,
@@ -882,12 +1026,16 @@ class ShardedTrainer:
                               for h in heads_k)
                 auxu = auxf
             else:
-                res = _grads_and_heads(params, aux, batch, rng, *scale_args)
+                ef_args = (([opt_state[k] for k in ef_keys],) if ef else ())
+                res = _grads_and_heads(params, aux, batch, rng, *scale_args,
+                                       *ef_args)
                 grads, heads, auxu = res[0], res[1], res[2]
-                if len(res) > 3:
+                rest = list(res[3:])
+                if resil is not None and explicit:
                     # explicit-comm path: guard stat came fused off the
                     # reduced flat buckets (no extra pass over grads)
-                    sq = res[3]
+                    sq = rest.pop(0)
+                new_ef = rest.pop(0) if ef else None
 
             # identity-tag the grads for the static auditor's HBM-pass
             # counter: mxtpu_tag lowers to nothing, so HLO, executables
@@ -936,6 +1084,18 @@ class ShardedTrainer:
             else:
                 new_params, new_opt = _unfused_apply(
                     params, grads, opt_state, lr, t, rng, ok)
+            if ef:
+                # a bad step keeps the OLD residual: the new one was
+                # computed from non-finite grads and would poison every
+                # following step's feedback
+                for k, nres in zip(ef_keys, new_ef):
+                    new_opt[k] = (jnp.where(ok, nres, opt_state[k])
+                                  if ok is not None else nres)
+            for k in opt_state:
+                # static opt-state riders (wd segment vectors) pass
+                # through unchanged — identity keeps donation aliasing
+                if k not in new_opt:
+                    new_opt[k] = opt_state[k]
             new_aux = dict(aux)
             if resil is not None:
                 for k, v in auxu.items():
@@ -974,10 +1134,14 @@ class ShardedTrainer:
                    for n in param_names}
         a_shard = {n: replicated(self.mesh) for n in self._aux_names}
         # opt state keys are param names on the unfused path, "fused:<i>"
-        # bucket keys on the fused path (always replicated there)
+        # bucket keys on the fused path (always replicated there);
+        # error-feedback residuals are per-shard, pinned to P(data)
+        def _opt_spec(k):
+            if k.startswith("efres:"):
+                return P(self.data_axis)
+            return self._zero_specs.get(k, P())
         o_shard = {k: jax.tree.map(
-            lambda _, _s=NamedSharding(
-                self.mesh, self._zero_specs.get(k, P())): _s,
+            lambda _, _s=NamedSharding(self.mesh, _opt_spec(k)): _s,
             self._opt_state[k]) for k in self._opt_state}
         # retrace guards: the counter bump is a host side effect, so it
         # fires only while jax traces the function — in steady state each
@@ -1069,7 +1233,11 @@ class ShardedTrainer:
                                  for n, s in self._zero_specs.items()),
             "grad_compression": self.grad_compression,
             "grad_bucket_bytes": self.grad_bucket_bytes,
+            "error_feedback": self.error_feedback,
+            "quant_block": _quant_block_key(self.grad_compression),
             "fused": self._fused_kind if self._fused else None,
+            "fused_wd_vec": bool(self._fused
+                                 and not self._fused_wd_uniform),
             "data_axis": self.data_axis,
             "rules": sorted((n, str(self.rules.spec_for(n)))
                             for n in self._param_names),
@@ -1460,6 +1628,11 @@ class ShardedTrainer:
         arrays = {f"param:{n}": self._params[n] for n in self._param_names}
         arrays.update({f"aux:{n}": self._aux[n] for n in self._aux_names})
         for key in self._opt_state:
+            if key.startswith("fusedwd:"):
+                # wd segment vectors are bind-time config, not training
+                # state: a restore must use THIS run's wd schedule, not
+                # resurrect the saving run's
+                continue
             for i, leaf in enumerate(
                     jax.tree_util.tree_leaves(self._opt_state[key])):
                 arrays[f"opt:{key}:{i}"] = leaf
@@ -1524,15 +1697,34 @@ class ShardedTrainer:
                 # ZeRO flat-pad lengths are f(data-axis size): restore to
                 # THIS mesh's padded length, not the saved one
                 target_shapes[name] = tuple(arr.shape)
-        arrays, meta, step = manager.restore(
-            step=step, shardings=shardings, target_shapes=target_shapes,
-            names=names)
+        try:
+            arrays, meta, step = manager.restore(
+                step=step, shardings=shardings, target_shapes=target_shapes,
+                names=names)
+        except MXNetError as e:
+            if "efres" not in str(e):
+                raise
+            # checkpoint predates error feedback: restore everything
+            # else and keep the bind-time zero residuals (worst case one
+            # step's sub-quantum correction is lost)
+            names = [n for n in names if not n.startswith("opt:efres:")]
+            arrays, meta, step = manager.restore(
+                step=step, shardings=shardings, target_shapes=target_shapes,
+                names=names)
+            self.logger.warning(
+                "restore_state: checkpoint has no error-feedback "
+                "residuals; starting them at zero")
         for n in self._param_names:
             self._params[n] = arrays[f"param:{n}"]
         for n in self._aux_names:
             self._aux[n] = arrays[f"aux:{n}"]
         for key in list(self._opt_state):
+            if key.startswith("fusedwd:"):
+                continue  # bind-time config, never checkpointed
             treedef = jax.tree_util.tree_structure(self._opt_state[key])
+            if any(f"opt:{key}:{i}" not in arrays
+                   for i in range(treedef.num_leaves)):
+                continue  # tolerated-missing (efres fallback above)
             leaves = [arrays[f"opt:{key}:{i}"]
                       for i in range(treedef.num_leaves)]
             self._opt_state[key] = jax.tree_util.tree_unflatten(treedef,

@@ -95,6 +95,50 @@ def fused_clean():
     return tr, {"expect_fused": True}
 
 
+def _data_mesh():
+    from jax.sharding import Mesh
+    devs = np.array(jax.devices())
+    return Mesh(devs, ("data",))
+
+
+def hbm_bytes_widened():
+    """The r9 regression class: a trainer configured for quantized grad
+    reduction whose bucket silently re-widened — the psum payload is
+    full-width f32, so every step moves 4x the contracted wire bytes."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+    mesh = _data_mesh()
+
+    def step(g):
+        def body(gl):
+            return jax.lax.psum(gl, "data")     # f32 on the wire
+        return shard_map(body, mesh=mesh, in_specs=P("data"),
+                         out_specs=P())(g)
+    n = 512 * len(jax.devices())
+    tr = jax.jit(step).trace(_SDS((n,), jnp.float32))
+    return tr, {"expect_wire_itemsize": 1}
+
+
+def hbm_bytes_quantized():
+    """Negative control for ``expect_wire_itemsize``: the bucket rides
+    the block-quantized fp8 reduction, so the narrowest same-shape value
+    in the psum's cone is the 1-byte payload and the audit stays
+    silent."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+    from mxnet_tpu.parallel.collectives import psum_compressed
+    mesh = _data_mesh()
+
+    def step(g):
+        def body(gl):
+            return psum_compressed(gl, "data", "fp8")
+        return shard_map(body, mesh=mesh, in_specs=P("data"),
+                         out_specs=P())(g)
+    n = 512 * len(jax.devices())
+    tr = jax.jit(step).trace(_SDS((n,), jnp.float32))
+    return tr, {"expect_wire_itemsize": 1}
+
+
 PROGRAMS = {
     "carry_widen": (carry_widen, ["program.carry-widen", "program.widen"]),
     "host_transfer": (host_transfer, ["program.host-transfer"]),
@@ -103,4 +147,6 @@ PROGRAMS = {
     "clean": (clean, []),
     "fused_regress": (fused_regress, ["program.fused-update"]),
     "fused_clean": (fused_clean, []),
+    "hbm_bytes_widened": (hbm_bytes_widened, ["program.hbm-bytes"]),
+    "hbm_bytes_quantized": (hbm_bytes_quantized, []),
 }

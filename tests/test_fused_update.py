@@ -99,18 +99,29 @@ KINDS = [
     ("sgd", {"learning_rate": 0.1, "momentum": 0.9,
              "wd": 0.01, "clip_gradient": 0.5}, True),
     ("adam", {"learning_rate": 1e-3}, False),
-    # uniform wd needs a bias-free net: wd_mult is 0 on *_bias params
+    # bias-free net: wd_mult uniform -> scalar wd into the kernel
     ("adamw", {"learning_rate": 1e-3, "wd": 0.01}, True),
+    # WITH biases wd_mult is 0 on *_bias params -> non-uniform wd rides
+    # the per-bucket wd segment vector ("fusedwd:<i>") into the kernel
+    # (adam is absent: folded wd has no bitwise fused twin — see the
+    # eligibility-gate test)
+    ("sgd", {"learning_rate": 0.1, "momentum": 0.9,
+             "wd": 0.01, "clip_gradient": 0.5}, False),
+    ("adamw", {"learning_rate": 1e-3, "wd": 0.01}, False),
 ]
 
 
 @pytest.mark.parametrize("opt,op,no_bias", KINDS,
                          ids=["sgd", "sgd_momentum", "sgd_wd_clip",
-                              "adam", "adamw"])
+                              "adam", "adamw", "sgd_wdvec",
+                              "adamw_wdvec"])
 def test_fused_is_bitwise_twin_of_unfused(opt, op, no_bias):
     a = _trainer(True, opt, op, no_bias=no_bias)
     b = _trainer(False, opt, op, no_bias=no_bias)
     assert a._fused and not b._fused
+    if op.get("wd") and not no_bias:
+        # per-param wd -> the segment vectors must exist, one per bucket
+        assert any(k.startswith("fusedwd:") for k in a._opt_state)
     _assert_twins(a, b, _feeds(), what=f"{opt}:{op}")
 
 
@@ -173,13 +184,33 @@ def test_fused_audit_one_read_one_write_and_unfused_baseline():
 
 
 def test_fused_eligibility_gate():
-    # per-param effective wd (bias wd_mult=0) cannot fuse: explicit
-    # fused_update=True raises, default (None) falls back silently
+    # per-param effective wd (bias wd_mult=0) is fused-ELIGIBLE since the
+    # wd segment-vector operand landed: the old silent fallback is gone
     op = {"learning_rate": 1e-3, "wd": 0.01}
-    with pytest.raises(MXNetError, match="cannot fuse"):
-        _trainer(True, "adamw", op)
     tr = _trainer(None, "adamw", op)
-    assert not tr._fused
+    assert tr._fused and not tr._fused_wd_uniform
+    assert any(k.startswith("fusedwd:") for k in tr._opt_state)
+    # ...and the segment vectors hold exactly wd * wd_mult per element
+    vec = np.asarray(tr._opt_state["fusedwd:0"])
+    assert set(np.unique(vec)) <= {np.float32(0.0), np.float32(0.01)}
+
+    # per-param lr_mult still cannot fuse
+    mx.random.seed(7)
+    tr = ShardedTrainer(_mlp(), mesh=make_mesh({"data": len(jax.devices())}),
+                        optimizer="adamw", optimizer_params=op,
+                        fused_update=True)
+    tr.optimizer.lr_mult = {"fc1_weight": 2.0}
+    with pytest.raises(MXNetError, match="cannot fuse"):
+        tr.bind(data_shapes={"data": (16, 8)},
+                label_shapes={"softmax_label": (16,)})
+
+    # adam's FOLDED wd (g + wd*w feeds both moments) has no bitwise
+    # fused twin — LLVM's FMA contraction of the fold is context-
+    # dependent.  Silent fallback on default, error when forced.  This
+    # also closes a latent hole: the old gate let uniform-wd adam fuse.
+    assert not _trainer(None, "adam", op)._fused
+    with pytest.raises(MXNetError, match="use adamw"):
+        _trainer(True, "adam", op)
 
     # env opt-out wins over the default
     os.environ["MXNET_TPU_FUSED_UPDATE"] = "0"
@@ -237,6 +268,17 @@ def test_pallas_kernel_matches_reference():
                                 wd=0.01, rescale_grad=0.25)),
         ("adamw", (s1, s2), dict(beta1=0.9, beta2=0.999, epsilon=1e-8,
                                  rescale_grad=0.25)),
+    ]
+    # the wd segment-vector operand (per-element effective wd)
+    wdv = jnp.asarray((rng.rand(n) < 0.5).astype(np.float32) * 0.01)
+    cases += [
+        ("sgd", (), dict(rescale_grad=0.25, wd_vec=wdv)),
+        ("sgd_momentum", (s1,), dict(momentum=0.9, clip_gradient=0.5,
+                                     rescale_grad=0.25, wd_vec=wdv)),
+        ("adam", (s1, s2), dict(beta1=0.9, beta2=0.999, epsilon=1e-8,
+                                rescale_grad=0.25, wd_vec=wdv)),
+        ("adamw", (s1, s2), dict(beta1=0.9, beta2=0.999, epsilon=1e-8,
+                                 rescale_grad=0.25, wd_vec=wdv)),
     ]
     for kind, state, hyper in cases:
         scalars = (np.float32(0.05),) if kind != "adamw" \

@@ -1,0 +1,358 @@
+"""r9 low-precision stack: block-scaled fp8 compute + error-feedback
+quantized collectives (mxnet_tpu/quant.py, parallel/collectives.py,
+trainer EF state).
+
+Three tiers: unit tests on the quantizers, convergence gates for the
+fp8 LM and the int8+EF wire (with plain int8 as the pinned NEGATIVE
+control — no feedback must be measurably worse), and the bitwise
+checkpoint round-trip of the residual state.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import models, quant
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.checkpoint import CheckpointManager
+from mxnet_tpu.parallel import ShardedTrainer, make_mesh
+from mxnet_tpu.quant import (FP8_MAX, QuantConfig, block_quantize,
+                             default_block_size, error_feedback_default,
+                             fp8_dot, fp8_linear, resolve_quant,
+                             symbol_uses_fp8, wire_itemsize)
+
+
+# ---------------------------------------------------------------------------
+# policy plumbing
+# ---------------------------------------------------------------------------
+
+def test_wire_itemsize():
+    assert wire_itemsize(None) == 4
+    assert wire_itemsize("bf16") == 2
+    assert wire_itemsize("int8") == 1
+    assert wire_itemsize("fp8") == 1
+    assert wire_itemsize(None, itemsize=2) == 2  # native bf16 buckets
+    with pytest.raises(MXNetError):
+        wire_itemsize("int4")
+
+
+def test_resolve_quant_specs(monkeypatch):
+    monkeypatch.delenv("MXNET_TPU_QUANT", raising=False)
+    assert resolve_quant(None) is None
+    assert resolve_quant(False) is None
+    cfg = resolve_quant("fp8")
+    assert cfg == QuantConfig(fwd="e4m3", bwd="e5m2",
+                              block=default_block_size())
+    assert resolve_quant(True) == cfg
+    explicit = QuantConfig(fwd="e4m3", bwd=None, block=32)
+    assert resolve_quant(explicit) is explicit
+    assert resolve_quant(QuantConfig(fwd=None, bwd=None)) is None
+    with pytest.raises(MXNetError):
+        resolve_quant("int4")
+    with pytest.raises(MXNetError):
+        QuantConfig(fwd="e3m4")
+
+
+def test_resolve_quant_env(monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_QUANT", "1")
+    assert resolve_quant(None) == QuantConfig(block=default_block_size())
+    # explicit argument always wins over the environment
+    assert resolve_quant(False) is None
+    monkeypatch.setenv("MXNET_TPU_QUANT", "0")
+    assert resolve_quant(None) is None
+
+
+def test_block_size_env(monkeypatch):
+    monkeypatch.delenv("MXNET_TPU_QUANT_BLOCK", raising=False)
+    assert default_block_size() == 128
+    monkeypatch.setenv("MXNET_TPU_QUANT_BLOCK", "64")
+    assert default_block_size() == 64
+    monkeypatch.setenv("MXNET_TPU_QUANT_BLOCK", "zero")
+    with pytest.raises(MXNetError):
+        default_block_size()
+
+
+def test_error_feedback_default(monkeypatch):
+    monkeypatch.delenv("MXNET_TPU_QUANT_EF", raising=False)
+    assert error_feedback_default(None) is False
+    assert error_feedback_default("bf16") is False
+    assert error_feedback_default("int8") is True
+    assert error_feedback_default("fp8") is True
+    monkeypatch.setenv("MXNET_TPU_QUANT_EF", "0")
+    assert error_feedback_default("int8") is False
+    monkeypatch.setenv("MXNET_TPU_QUANT_EF", "1")
+    assert error_feedback_default("bf16") is True
+
+
+def test_symbol_uses_fp8():
+    kw = dict(vocab_size=16, num_layers=1, d_model=16, heads=2,
+              batch_size=2, seq_len=4)
+    assert not symbol_uses_fp8(models.get_symbol("transformer-lm", **kw))
+    assert symbol_uses_fp8(models.get_symbol("transformer-lm", quant="fp8",
+                                             **kw))
+
+
+# ---------------------------------------------------------------------------
+# block-scaled quantizers
+# ---------------------------------------------------------------------------
+
+def test_block_quantize_bounds():
+    """Per-element error is bounded by the BLOCK absmax over the e4m3
+    grid spacing — one outlier poisons its 16-element block, nothing
+    else — and the block absmax itself round-trips exactly (the scale
+    pins it onto the format's largest finite value)."""
+    rng = np.random.RandomState(0)
+    x = rng.randn(8, 64).astype(np.float32)
+    x[3, 17] = 100.0                       # an outlier in block 1 of row 3
+    block = 16
+    q, scale = block_quantize(jnp.asarray(x), "e4m3", block)
+    assert q.shape == (64 // block, 8, block)
+    assert scale.shape == (64 // block, 8, 1)
+    deq = (np.asarray(q, np.float32) * np.asarray(scale)).transpose(1, 0, 2)
+    xb = x.reshape(8, 64 // block, block)
+    absmax = np.abs(xb).max(axis=-1, keepdims=True)
+    # e4m3 spacing at the top of the range is absmax/14; half of it
+    # bounds round-to-nearest, /20 leaves slack
+    assert np.all(np.abs(deq - xb) < absmax / 20 + 1e-12)
+    # block maxima land on +-448 * scale (to f32 division rounding)
+    deq_absmax = np.abs(deq).max(axis=-1, keepdims=True)
+    np.testing.assert_allclose(deq_absmax, absmax, rtol=1e-6)
+    # the outlier block's error scales with the outlier; its NEIGHBOR
+    # block keeps fine resolution
+    clean = np.abs(deq[3, 0] - xb[3, 0]).max()
+    assert clean < np.abs(xb[3, 0]).max() / 14
+
+
+def test_fp8_dot_close_to_f32():
+    rng = np.random.RandomState(1)
+    a = rng.randn(24, 96).astype(np.float32)
+    b = rng.randn(12, 96).astype(np.float32)
+    ref = a @ b.T
+    out = np.asarray(fp8_dot(jnp.asarray(a), jnp.asarray(b),
+                             "e4m3", "e4m3", block=32))
+    assert out.shape == ref.shape
+    rel = np.linalg.norm(out - ref) / np.linalg.norm(ref)
+    assert rel < 0.05, rel
+
+
+def test_fp8_linear_forward_and_grads():
+    rng = np.random.RandomState(2)
+    x = rng.randn(10, 48).astype(np.float32)
+    w = rng.randn(20, 48).astype(np.float32)
+    cfg = QuantConfig(fwd="e4m3", bwd="e5m2", block=16)
+
+    def loss(x, w):
+        return jnp.sum(fp8_linear(x, w, cfg) ** 2)
+
+    def loss_ref(x, w):
+        return jnp.sum((x @ w.T) ** 2)
+
+    out = np.asarray(fp8_linear(jnp.asarray(x), jnp.asarray(w), cfg))
+    ref = x @ w.T
+    assert np.linalg.norm(out - ref) / np.linalg.norm(ref) < 0.05
+    gx, gw = jax.grad(loss, argnums=(0, 1))(jnp.asarray(x), jnp.asarray(w))
+    rx, rw = jax.grad(loss_ref, argnums=(0, 1))(jnp.asarray(x),
+                                                jnp.asarray(w))
+    for g, r in ((gx, rx), (gw, rw)):
+        g, r = np.asarray(g), np.asarray(r)
+        assert g.shape == r.shape
+        assert np.linalg.norm(g - r) / np.linalg.norm(r) < 0.15
+        # direction agrees — a quantized descent step still descends
+        cos = np.sum(g * r) / (np.linalg.norm(g) * np.linalg.norm(r))
+        assert cos > 0.98, cos
+
+
+def test_fp8_linear_bwd_only_forward_exact():
+    """fwd=None keeps the forward exact (bitwise vs the f32 matmul);
+    only the gradient edges quantize."""
+    rng = np.random.RandomState(3)
+    x = rng.randn(6, 32).astype(np.float32)
+    w = rng.randn(8, 32).astype(np.float32)
+    cfg = QuantConfig(fwd=None, bwd="e5m2", block=16)
+    out = np.asarray(fp8_linear(jnp.asarray(x), jnp.asarray(w), cfg))
+    ref = np.asarray(jnp.asarray(x) @ jnp.asarray(w).T)  # same backend gemm
+    np.testing.assert_array_equal(out, ref)
+
+
+# ---------------------------------------------------------------------------
+# fp8 LM convergence (compute layer, end to end)
+# ---------------------------------------------------------------------------
+
+_LM_KW = dict(vocab_size=32, num_layers=1, d_model=32, heads=2,
+              batch_size=8, seq_len=8)
+
+
+def _lm_losses(quant_spec, steps=40, seed=11):
+    rng = np.random.RandomState(seed)
+    # learnable structure: each token mostly repeats its predecessor
+    ids = np.zeros((steps, 8, 9), np.int64)
+    for s in range(steps):
+        tok = rng.randint(32, size=8)
+        for p in range(9):
+            flip = rng.rand(8) < 0.1
+            tok = np.where(flip, rng.randint(32, size=8), tok)
+            ids[s, :, p] = tok
+    mx.random.seed(4)
+    sym = models.get_symbol("transformer-lm", quant=quant_spec,
+                            loss_head=True, **_LM_KW)
+    tr = ShardedTrainer(sym, optimizer="adam",
+                        optimizer_params={"learning_rate": 3e-3},
+                        mesh=make_mesh({"data": 1}, jax.devices()[:1]))
+    tr.bind(data_shapes={"data": (8, 8)},
+            label_shapes={"softmax_label": (8, 8)})
+    losses = []
+    for s in range(steps):
+        batch = {"data": ids[s, :, :8].astype(np.float32),
+                 "softmax_label": ids[s, :, 1:].astype(np.float32)}
+        out = tr.step(batch)
+        losses.append(float(np.mean(np.asarray(out[0]))))
+    return losses
+
+
+def test_fp8_lm_trains_within_tolerance_of_f32():
+    base = _lm_losses(None)
+    fp8 = _lm_losses("fp8")
+    # both learn: final loss well below the ~log(32)=3.47 random floor
+    tail_base = float(np.mean(base[-5:]))
+    tail_fp8 = float(np.mean(fp8[-5:]))
+    assert tail_base < 2.8
+    assert tail_fp8 < 2.8
+    # and the fp8 trajectory tracks the f32 one
+    assert abs(tail_fp8 - tail_base) < 0.25, (tail_base, tail_fp8)
+
+
+# ---------------------------------------------------------------------------
+# error-feedback collectives: convergence + the no-feedback negative
+# control
+# ---------------------------------------------------------------------------
+
+def _mlp():
+    data = mx.symbol.Variable("data")
+    fc1 = mx.symbol.FullyConnected(data=data, name="fc1", num_hidden=16)
+    act = mx.symbol.Activation(data=fc1, name="relu1", act_type="relu")
+    fc2 = mx.symbol.FullyConnected(data=act, name="fc2", num_hidden=4)
+    return mx.symbol.SoftmaxOutput(data=fc2, name="softmax")
+
+
+def _ef_trainer(grad_compression, error_feedback=None, optimizer="sgd",
+                lr=0.05):
+    mx.random.seed(9)
+    tr = ShardedTrainer(_mlp(), optimizer=optimizer,
+                        optimizer_params={"learning_rate": lr,
+                                          "momentum": 0.9},
+                        mesh=make_mesh({"data": -1}),
+                        grad_compression=grad_compression,
+                        error_feedback=error_feedback)
+    tr.bind({"data": (32, 8)}, {"softmax_label": (32,)})
+    return tr
+
+
+def _toy_batches(n_steps, seed=3):
+    rs = np.random.RandomState(seed)
+    w = rs.randn(8, 4).astype(np.float32)
+    batches = []
+    for _ in range(n_steps):
+        x = rs.randn(32, 8).astype(np.float32)
+        y = np.argmax(x @ w, axis=1).astype(np.float32)
+        batches.append({"data": x, "softmax_label": y})
+    return batches
+
+
+def _param_vec(tr):
+    params = tr.get_params()[0]
+    return np.concatenate([params[n].asnumpy().ravel()
+                           for n in sorted(params)])
+
+
+def test_ef_defaults_and_validation():
+    assert _ef_trainer("int8").error_feedback is True
+    assert _ef_trainer("fp8").error_feedback is True
+    assert _ef_trainer("bf16").error_feedback is False
+    assert _ef_trainer(None).error_feedback is False
+    assert _ef_trainer("int8", error_feedback=False).error_feedback is False
+    with pytest.raises(MXNetError):
+        ShardedTrainer(_mlp(), optimizer="sgd",
+                       mesh=make_mesh({"data": -1}),
+                       error_feedback=True)
+
+
+def test_efres_state_shape_and_sharding():
+    tr = _ef_trainer("int8")
+    keys = [k for k in tr._opt_state if k.startswith("efres:")]
+    assert keys == ["efres:0"]
+    res = tr._opt_state["efres:0"]
+    assert res.dtype == jnp.float32
+    assert res.ndim == 1
+    assert not np.any(np.asarray(res))        # starts at zero
+    # no residual state without EF
+    off = _ef_trainer("int8", error_feedback=False)
+    assert not any(k.startswith("efres:") for k in off._opt_state)
+
+
+def test_error_feedback_beats_plain_int8():
+    """The negative control the r9 acceptance pins: with feedback the
+    quantized trajectory hugs the exact-f32 one; WITHOUT feedback the
+    per-step rounding bias random-walks the params measurably further
+    away.  Same seeds, same batches, only the residual differs."""
+    batches = _toy_batches(40)
+    runs = {}
+    for name, (comp, ef) in {"f32": (None, None),
+                             "ef": ("int8", True),
+                             "plain": ("int8", False)}.items():
+        tr = _ef_trainer(comp, error_feedback=ef)
+        for b in batches:
+            tr.step(b)
+        runs[name] = _param_vec(tr)
+    drift_ef = np.linalg.norm(runs["ef"] - runs["f32"])
+    drift_plain = np.linalg.norm(runs["plain"] - runs["f32"])
+    # feedback must land meaningfully closer to the exact trajectory
+    assert drift_ef < drift_plain / 1.5, (drift_ef, drift_plain)
+
+
+def test_int8_ef_converges_like_f32():
+    batches = _toy_batches(8, seed=6)
+
+    def final_acc(comp):
+        tr = _ef_trainer(comp, lr=0.2)
+        for _ in range(10):                  # epochs over a fixed set
+            for b in batches:
+                tr.step(b)
+        x = np.concatenate([b["data"] for b in batches])
+        y = np.concatenate([b["softmax_label"] for b in batches])
+        it = mx.io.NDArrayIter(x, y, batch_size=32)
+        return tr.score(it, "acc").get()[1]
+
+    acc_f32 = final_acc(None)
+    acc_int8 = final_acc("int8")
+    assert acc_f32 > 0.7
+    assert acc_int8 >= acc_f32 - 0.05
+
+
+# ---------------------------------------------------------------------------
+# residual checkpointing: bitwise round-trip, bitwise continuation
+# ---------------------------------------------------------------------------
+
+def test_efres_bitwise_checkpoint_roundtrip(tmp_path):
+    batches = _toy_batches(6, seed=8)
+    tr = _ef_trainer("int8")
+    for b in batches[:3]:
+        tr.step(b)
+    res_before = np.asarray(tr._opt_state["efres:0"])
+    assert np.any(res_before)                 # the residual is live
+    mgr = CheckpointManager(str(tmp_path))
+    tr.save_state(mgr)
+
+    tr2 = _ef_trainer("int8")
+    tr2.restore_state(mgr)
+    np.testing.assert_array_equal(
+        np.asarray(tr2._opt_state["efres:0"]).view(np.uint32),
+        res_before.view(np.uint32))           # BITWISE round-trip
+
+    # the restored run continues the identical trajectory, bit for bit
+    for b in batches[3:]:
+        tr.step(b)
+        tr2.step(b)
+    a, b2 = _param_vec(tr), _param_vec(tr2)
+    np.testing.assert_array_equal(a.view(np.uint32), b2.view(np.uint32))

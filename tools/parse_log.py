@@ -157,10 +157,11 @@ def diff_resilience(path_a, path_b):
 
 
 def read_audits(path):
-    """{metric: row} for the grad-bucket audit rows of a ``bench.py
-    --audit`` report.  Accepts either a whole-file JSON array (the
-    BENCH_r08.json format) or one JSON object per line (tee'd stdout);
-    audit rows are the ones carrying ``writes_per_bucket``."""
+    """{metric: row} for the audit rows of a ``bench.py --audit``
+    report.  Accepts either a whole-file JSON array (the BENCH_r09.json
+    format) or one JSON object per line (tee'd stdout); audit rows are
+    the grad-bucket HBM-pass ones (``writes_per_bucket``) and the r9
+    collective wire-bytes ones (``wire_bytes``)."""
     with open(path) as f:
         text = f.read()
     try:
@@ -181,12 +182,16 @@ def read_audits(path):
     # ", unfused" label; normalize it away so r7->r8 diffs line up the
     # like-for-like rows (the fused rows stay distinct)
     return {rec["metric"].replace(", unfused,", ","): rec for rec in recs
-            if isinstance(rec, dict) and "writes_per_bucket" in rec}
+            if isinstance(rec, dict)
+            and ("writes_per_bucket" in rec or "wire_bytes" in rec)}
 
 
+# a wire-bytes row reuses "value" for the f32/wire compression ratio, so
+# the reads column doubles as it there; the wire column stays empty on
+# HBM-pass rows and vice versa
 AUDIT_KEYS = (("reads", "value"), ("writes", "writes_per_bucket"),
-              ("buckets", "buckets"), ("findings", "findings"),
-              ("pass", "pass"))
+              ("buckets", "buckets"), ("wire_B", "wire_bytes"),
+              ("findings", "findings"), ("pass", "pass"))
 
 
 def diff_audits(path_a, path_b):
@@ -214,8 +219,13 @@ def diff_audits(path_a, path_b):
             if (isinstance(va, (int, float)) and isinstance(vb, (int, float))
                     and not isinstance(va, bool) and not isinstance(vb, bool)):
                 cells.append(f"{vb - va:+g}")
-                if key in ("value", "writes_per_bucket", "findings"):
+                if key in ("writes_per_bucket", "findings", "wire_bytes"):
                     worse += vb > va
+                elif key == "value":
+                    # reads/bucket must not grow; a compression ratio
+                    # (wire-bytes row) must not SHRINK
+                    worse += ((vb < va) if "wire_bytes" in ra
+                              else (vb > va))
             else:
                 cells.append("")
         print(f"| {metric} | " + " | ".join(cells) + " |")
