@@ -172,11 +172,34 @@ def _step_flops(trainer, placed, flops_symbol=None):
         return None
 
 
+def _tee(rec):
+    """Mirror a result row into the telemetry JSONL stream (no-op unless
+    MXNET_TPU_METRICS_FILE is set): audit rows carry byte/pass counts,
+    everything else is a bench row.  tools/parse_log.py --diff-metrics
+    diffs both kinds across runs."""
+    from mxnet_tpu import telemetry
+    kind = ("audit" if ("writes_per_bucket" in rec or "wire_bytes" in rec)
+            else "bench")
+    telemetry.emit(kind, rec)
+
+
+def _emit_row(rec):
+    print(json.dumps(rec))
+    _tee(rec)
+    return rec
+
+
 def report(metric, value, unit, vs_baseline, per_step, dispatch, compile_s,
            flops, precision):
     import jax
+    from mxnet_tpu import telemetry
     peak = _peak_flops()
     tflops = (flops / per_step / 1e12) if flops else None
+    if flops:
+        # feed the derived-gauge denominators (derived.mfu /
+        # derived.flops_per_s) for any steps run after this report
+        telemetry.set_program_costs(flops_per_step=flops,
+                                    peak_flops_per_s=peak or None)
     rec = {
         "metric": metric,
         "value": round(value, 1),
@@ -191,6 +214,7 @@ def report(metric, value, unit, vs_baseline, per_step, dispatch, compile_s,
         "precision": precision,
     }
     print(json.dumps(rec))
+    _tee(rec)
     return rec
 
 
@@ -200,8 +224,10 @@ def _emit_step_profile(trainer, host_feeds, steps, title):
     from mxnet_tpu import profiler
     prof = profiler.profile_step(trainer, host_feeds, steps=steps)
     print(profiler.format_step_profile(prof, title))
-    print(json.dumps({"step_profile": {k: round(v, 4) for k, v in prof.items()},
-                      "metric": title}))
+    row = {"step_profile": {k: round(v, 4) for k, v in prof.items()},
+           "metric": title}
+    _emit_row(row)
+    _tee(row)
     return prof
 
 
@@ -296,7 +322,7 @@ def bench_grad_comm(args):
             "speedup_vs_per_tensor": round(t_per_tensor / t, 2),
             "n_devices": len(devs),
         })
-        print(json.dumps(rows[-1]))
+        _emit_row(rows[-1])
     return rows
 
 
@@ -481,7 +507,7 @@ def bench_checkpoint(args):
             "state_mib": round(state_bytes / 2**20, 1),
             "n_devices": len(jax.devices()),
         })
-        print(json.dumps(rows[-1]))
+        _emit_row(rows[-1])
     return rows
 
 
@@ -570,7 +596,7 @@ def bench_resilience(args):
             "n_devices": len(jax.devices()),
             "precision": args.compute_dtype or args.precision,
         })
-        print(json.dumps(rows[-1]))
+        _emit_row(rows[-1])
     out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                        "BENCH_r06.json")
     with open(out, "w") as f:
@@ -649,6 +675,13 @@ def bench_audit(args):
             elapsed = time.perf_counter() - t0
             hbm = report.metrics.get("trainer.train", {}).get("hbm_passes", {})
             buckets = hbm.get("buckets", [])
+            if buckets and hbm.get("max_reads") is not None:
+                # grad-bucket HBM traffic per step from the auditor's own
+                # byte counts -> derived.hbm_gbps denominator
+                from mxnet_tpu import telemetry
+                telemetry.set_program_costs(
+                    hbm_bytes_per_step=sum(b["bytes"] for b in buckets)
+                    * (hbm["max_reads"] + (hbm.get("max_writes") or 0)))
             label = "fused" if fused else "unfused"
             passed = bool(report.clean) and (
                 not fused or (hbm.get("max_reads") == 1
@@ -670,7 +703,7 @@ def bench_audit(args):
                 "audit_s": round(elapsed, 2),
                 "n_devices": len(jax.devices()),
             })
-            print(json.dumps(rows[-1]))
+            _emit_row(rows[-1])
 
     for name, make_sym, dshapes, lshapes, kw in configs:
         from mxnet_tpu.parallel import ShardedTrainer, make_mesh
@@ -702,7 +735,7 @@ def bench_audit(args):
                 "audit_s": round(elapsed, 2),
                 "n_devices": len(jax.devices()),
             })
-            print(json.dumps(rows[-1]))
+            _emit_row(rows[-1])
     out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                        "BENCH_r09.json")
     with open(out, "w") as f:
@@ -809,7 +842,7 @@ def bench_twin_gap(args):
                   "(--twin-batch 256 --twin-image 224)",
         "n_devices": len(jax.devices()),
     }
-    print(json.dumps(row))
+    _emit_row(row)
     out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                        "BENCH_r08.json")
     rows = []
@@ -889,7 +922,7 @@ def bench_compile(args):
             "step_ok": loss_ok,
             "n_devices": len(jax.devices()),
         }
-        print(json.dumps(row))
+        _emit_row(row)
         rows.append(row)
 
     rng = np.random.RandomState(0)
@@ -997,7 +1030,7 @@ def _bench_bucketed_lm(args):
         "mismatched_lengths": mismatches,
         "n_devices": len(jax.devices()),
     }
-    print(json.dumps(row))
+    _emit_row(row)
     return row
 
 

@@ -58,6 +58,7 @@ from . import monitor
 from .monitor import Monitor
 from . import rtc
 from . import predictor
+from . import telemetry
 from . import profiler
 from . import resilience
 from . import chaos
@@ -77,5 +78,6 @@ __all__ = [
     "kvstore", "executor_manager", "model", "FeedForward", "lr_scheduler",
     "Initializer", "Uniform", "Normal", "Xavier", "Orthogonal", "Optimizer",
     "save_checkpoint", "load_checkpoint", "checkpoint", "CheckpointManager",
-    "compile_cache", "resilience", "chaos", "analysis",
+    "compile_cache", "resilience", "chaos", "analysis", "telemetry",
+    "profiler", "monitor", "Monitor",
 ]

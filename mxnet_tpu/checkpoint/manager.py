@@ -237,6 +237,12 @@ class CheckpointManager:
             already = self.preempted
             self.preempted = True
             if not already:
+                # postmortem evidence first: the forced save (or a chained
+                # handler) may be the last thing this process ever does
+                from .. import telemetry
+                telemetry.dump_flight(
+                    "sigterm", extra={"signum": int(signum),
+                                      "restoring": self._restoring})
                 if self._restoring:
                     # mid-rollback state is a mix of old and new arrays;
                     # saving it would clobber the good checkpoint.  The

@@ -32,6 +32,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from .. import telemetry
 from ..base import MXNetError
 from . import layout
 
@@ -68,25 +69,28 @@ def _host_leaf(value) -> List[Tuple[Optional[List[List[int]]], np.ndarray]]:
 def snapshot(arrays: Dict[str, Any]) -> Dict[str, List[Tuple]]:
     """Device -> host snapshot of ``{name: array}``; the only part of a
     save that must complete before the next (donating) train step."""
-    # start every D2H transfer before reading any: the fetches pipeline
-    # instead of serializing one blocking device_get at a time
-    for v in arrays.values():
-        start = getattr(v, "copy_to_host_async", None)
-        if start is not None:
-            try:
-                start()
-            except Exception:
-                pass  # deleted/donated buffers surface in _host_leaf
-    snap = {}
-    for name, v in arrays.items():
-        buf = getattr(v, "is_deleted", lambda: False)()
-        if buf:
-            raise MXNetError(
-                f"checkpoint snapshot: array {name!r} was already donated "
-                "to a compiled step — snapshot state refs before the next "
-                "trainer.step() runs (save_state does this for you)")
-        snap[name] = _host_leaf(v)
-    return snap
+    with telemetry.span("ckpt.snapshot", arrays=len(arrays)):
+        # start every D2H transfer before reading any: the fetches
+        # pipeline instead of serializing one blocking device_get at a
+        # time
+        for v in arrays.values():
+            start = getattr(v, "copy_to_host_async", None)
+            if start is not None:
+                try:
+                    start()
+                except Exception:
+                    pass  # deleted/donated buffers surface in _host_leaf
+        snap = {}
+        for name, v in arrays.items():
+            buf = getattr(v, "is_deleted", lambda: False)()
+            if buf:
+                raise MXNetError(
+                    f"checkpoint snapshot: array {name!r} was already "
+                    "donated to a compiled step — snapshot state refs "
+                    "before the next trainer.step() runs (save_state "
+                    "does this for you)")
+            snap[name] = _host_leaf(v)
+        return snap
 
 
 def write_checkpoint(root: str, step: int, snap: Dict[str, List[Tuple]],
@@ -99,47 +103,54 @@ def write_checkpoint(root: str, step: int, snap: Dict[str, List[Tuple]],
     if os.path.exists(staging):
         shutil.rmtree(staging)
     os.makedirs(staging)
-    try:
-        entries: Dict[str, Any] = {}
-        for ai, (name, leaves) in enumerate(sorted(snap.items())):
-            shards = []
-            shape = dtype_str = None
-            for si, (index, host) in enumerate(leaves):
-                host = np.ascontiguousarray(host)
-                if index is None:
-                    index = [[0, int(d)] for d in host.shape]
-                    shape, dtype_str = list(host.shape), host.dtype.str
-                payload = host.tobytes()
-                fname = layout.shard_file_name(ai, si, process_index)
-                with open(os.path.join(staging, fname), "wb") as f:
-                    f.write(payload)
-                    f.flush()
-                    os.fsync(f.fileno())
-                shards.append({"file": fname,
-                               "index": index,
-                               "nbytes": len(payload),
-                               "checksum": layout.checksum_bytes(payload)})
-            if shape is None:
-                # sharded leaves: global shape = max stop per dim
-                shape = [max(s["index"][d][1] for s in shards)
-                         for d in range(len(shards[0]["index"]))]
-                dtype_str = np.dtype(leaves[0][1].dtype).str
-            entries[name] = layout.make_array_entry(shape, dtype_str, shards)
-        # manifest last: its presence is the commit marker inside the dir
-        layout.write_manifest(staging, step, entries, meta=meta,
-                              process_count=process_count)
-        if os.path.exists(final):
-            shutil.rmtree(final)  # overwrite a same-step checkpoint
-        os.replace(staging, final)
-    except BaseException:
-        shutil.rmtree(staging, ignore_errors=True)
-        raise
-    # make the rename itself durable
-    dirfd = os.open(root, os.O_RDONLY)
-    try:
-        os.fsync(dirfd)
-    finally:
-        os.close(dirfd)
+    written = 0
+    with telemetry.span("ckpt.write", step=step, arrays=len(snap)):
+        try:
+            entries: Dict[str, Any] = {}
+            for ai, (name, leaves) in enumerate(sorted(snap.items())):
+                shards = []
+                shape = dtype_str = None
+                for si, (index, host) in enumerate(leaves):
+                    host = np.ascontiguousarray(host)
+                    if index is None:
+                        index = [[0, int(d)] for d in host.shape]
+                        shape, dtype_str = list(host.shape), host.dtype.str
+                    payload = host.tobytes()
+                    written += len(payload)
+                    fname = layout.shard_file_name(ai, si, process_index)
+                    with open(os.path.join(staging, fname), "wb") as f:
+                        f.write(payload)
+                        f.flush()
+                        os.fsync(f.fileno())
+                    shards.append({"file": fname,
+                                   "index": index,
+                                   "nbytes": len(payload),
+                                   "checksum": layout.checksum_bytes(payload)})
+                if shape is None:
+                    # sharded leaves: global shape = max stop per dim
+                    shape = [max(s["index"][d][1] for s in shards)
+                             for d in range(len(shards[0]["index"]))]
+                    dtype_str = np.dtype(leaves[0][1].dtype).str
+                entries[name] = layout.make_array_entry(shape, dtype_str,
+                                                        shards)
+            # manifest last: its presence is the commit marker inside the dir
+            layout.write_manifest(staging, step, entries, meta=meta,
+                                  process_count=process_count)
+            if os.path.exists(final):
+                shutil.rmtree(final)  # overwrite a same-step checkpoint
+            os.replace(staging, final)
+        except BaseException:
+            telemetry.counter("ckpt.write_errors").inc()
+            shutil.rmtree(staging, ignore_errors=True)
+            raise
+        # make the rename itself durable
+        dirfd = os.open(root, os.O_RDONLY)
+        try:
+            os.fsync(dirfd)
+        finally:
+            os.close(dirfd)
+    telemetry.counter("ckpt.saves").inc()
+    telemetry.counter("ckpt.bytes").inc(written)
     return final
 
 
@@ -202,6 +213,7 @@ class AsyncCheckpointWriter:
             self._thread.start()
 
     def _worker(self):
+        telemetry.name_thread("ckpt-writer")
         while True:
             job = self._queue.get()
             if job is None:

@@ -221,13 +221,13 @@ class ProgramCache:
             ent = self._mem.get(key.digest)
             if ent is not None:
                 self._mem.move_to_end(key.digest)
-                self.stats["memory_hits"] += 1
+                self._bump_stat("memory_hits")
                 self._last_source = "memory"
                 return ent
         compiled = self._disk_load(key)
         if compiled is not None:
             self._mem_put(key.digest, compiled)
-            self.stats["disk_hits"] += 1
+            self._bump_stat("disk_hits")
         return compiled
 
     def put(self, key: CacheKey, compiled, label: str = "",
@@ -235,7 +235,7 @@ class ProgramCache:
         if not self.enabled:
             return
         self._mem_put(key.digest, compiled)
-        self.stats["puts"] += 1
+        self._bump_stat("puts")
         self._disk_store(key, compiled, label, compile_seconds)
 
     def get_or_compile(self, key: CacheKey, compile_fn: Callable[[], Any],
@@ -251,14 +251,24 @@ class ProgramCache:
                     time.perf_counter() - t0, "digest": key.digest}
             self._record(label, info)
             return compiled, info
-        compiled = compile_fn()
+        from . import telemetry
+        with telemetry.span("compile.build", label=label or "program",
+                            digest=key.digest[:12]):
+            compiled = compile_fn()
         seconds = time.perf_counter() - t0
-        self.stats["misses"] += 1
+        self._bump_stat("misses")
         self.put(key, compiled, label=label, compile_seconds=seconds)
         info = {"source": "compile", "seconds": seconds,
                 "digest": key.digest}
         self._record(label, info)
         return compiled, info
+
+    def _bump_stat(self, key: str) -> None:
+        """Increment a cache stat and its unified-telemetry mirror
+        (``compile_cache.<stat>`` counters, docs/observability.md)."""
+        self.stats[key] += 1
+        from . import telemetry
+        telemetry.counter(f"compile_cache.{key}").inc()
 
     def _record(self, label: str, info: Dict[str, Any]) -> None:
         from . import profiler
@@ -287,7 +297,7 @@ class ProgramCache:
             return serialize_executable.deserialize_and_load(
                 payload, in_tree, out_tree)
         except Exception as e:
-            self.stats["disk_errors"] += 1
+            self._bump_stat("disk_errors")
             _log.warning("program cache: failed to load %s (%s) — treating "
                          "as a miss", key.digest[:12], e)
             return None
@@ -310,7 +320,7 @@ class ProgramCache:
                     "fields": key.describe()}
             _atomic_write(metap, json.dumps(meta, indent=1).encode())
         except Exception as e:
-            self.stats["disk_errors"] += 1
+            self._bump_stat("disk_errors")
             _log.debug("program cache: could not persist %s (%s)",
                        key.digest[:12], e)
 
@@ -415,11 +425,17 @@ def notify_lowering(label: str, traced: Any) -> None:
     logged, never raised — an analysis bug must not break compilation."""
     with _glock:
         observers = list(_lowering_observers)
-    for fn in observers:
-        try:
-            fn(label, traced)
-        except Exception:
-            _log.exception("lowering observer %r failed on %r", fn, label)
+    if not observers:
+        return
+    from . import telemetry
+    with telemetry.span("compile.lowering", label=label,
+                        observers=len(observers)):
+        for fn in observers:
+            try:
+                fn(label, traced)
+            except Exception:
+                _log.exception("lowering observer %r failed on %r",
+                               fn, label)
 
 
 def enable_persistent_cache(cache_dir: str) -> None:

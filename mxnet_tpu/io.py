@@ -509,15 +509,20 @@ class DevicePrefetchIter(DataIter):
                         raise
 
         def worker():
+            from . import telemetry
+            telemetry.name_thread("prefetch")
+            n = 0
             try:
                 while not stop.is_set():
-                    try:
-                        batch = call_retrying("iterator", inner.next)
-                    except StopIteration:
-                        put(DevicePrefetchIter._END)
-                        return
-                    put(("batch", call_retrying("place_fn", place, batch),
-                         batch))
+                    with telemetry.span("prefetch.batch", n=n):
+                        try:
+                            batch = call_retrying("iterator", inner.next)
+                        except StopIteration:
+                            put(DevicePrefetchIter._END)
+                            return
+                        staged = call_retrying("place_fn", place, batch)
+                    n += 1
+                    put(("batch", staged, batch))
             except BaseException as exc:  # propagate to the consumer
                 put(("error", exc, None))
 

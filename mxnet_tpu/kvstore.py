@@ -128,30 +128,36 @@ class KVStore:
         if not self._pending:
             return
         import jax
+        from . import telemetry
         from .parallel.collectives import allreduce_sum
-        pending, self._pending, self._pending_bytes = self._pending, [], 0
-        multi = [i for i, (_, _, datas) in enumerate(pending)
-                 if len(datas) > 1]
-        merged_by_i = {}
-        if multi:
-            # one bucketed reduce over every multi-device group; groups
-            # with co-resident shards fall back internally to a tree sum
-            reduced = allreduce_sum(
-                [pending[i][2] for i in multi],
-                priorities=[pending[i][0] for i in multi],
-                bucket_bytes=self._bucket_bytes,
-                compression=self._compression)
-            for i, r in zip(multi, reduced):
-                merged_by_i[i] = r[0]
-        for i, (_, k, datas) in enumerate(pending):
-            merged_val = merged_by_i.get(i, datas[0])
-            dev = self._store[k].context.jax_device
-            merged_nd = NDArray(jax.device_put(merged_val, dev),
-                                ctx=self._store[k].context)
-            if self._updater is not None:
-                self._updater(k, merged_nd, self._store[k])
-            else:
-                self._merge_buf[k] = merged_nd
+        with telemetry.span("collective.flush",
+                            pending=len(self._pending),
+                            bytes=self._pending_bytes):
+            pending, self._pending, self._pending_bytes = \
+                self._pending, [], 0
+            multi = [i for i, (_, _, datas) in enumerate(pending)
+                     if len(datas) > 1]
+            merged_by_i = {}
+            if multi:
+                # one bucketed reduce over every multi-device group;
+                # groups with co-resident shards fall back internally to
+                # a tree sum
+                reduced = allreduce_sum(
+                    [pending[i][2] for i in multi],
+                    priorities=[pending[i][0] for i in multi],
+                    bucket_bytes=self._bucket_bytes,
+                    compression=self._compression)
+                for i, r in zip(multi, reduced):
+                    merged_by_i[i] = r[0]
+            for i, (_, k, datas) in enumerate(pending):
+                merged_val = merged_by_i.get(i, datas[0])
+                dev = self._store[k].context.jax_device
+                merged_nd = NDArray(jax.device_put(merged_val, dev),
+                                    ctx=self._store[k].context)
+                if self._updater is not None:
+                    self._updater(k, merged_nd, self._store[k])
+                else:
+                    self._merge_buf[k] = merged_nd
 
     def pull(self, key, out=None, priority: int = 0) -> None:
         self._flush()

@@ -91,6 +91,11 @@ class Monitor:
         return res
 
     def toc_print(self) -> None:
-        """Stop collecting and log the results."""
+        """Stop collecting and log the results.  Each row also lands in
+        the telemetry stream (kind ``monitor``) when
+        ``MXNET_TPU_METRICS_FILE`` is set, so tensor stats are greppable
+        next to step records instead of living only in the log."""
+        from . import telemetry
         for n, k, v in self.toc():
             logging.info("Batch: %7d %30s %s", n, k, v)
+            telemetry.emit("monitor", {"step": n, "tensor": k, "stat": v})

@@ -132,6 +132,15 @@ def count_collectives():
 
 
 def _emit(rec: dict) -> None:
+    # unified-telemetry mirror: the same per-dispatch record that feeds
+    # CollectiveStats lands in the process-wide registry, so wire-byte
+    # totals are scrape()-able without opening a count_collectives scope
+    from .. import telemetry
+    telemetry.counter("collectives.dispatches").inc()
+    nbytes = rec.get("nbytes", 0)
+    telemetry.counter("collectives.bytes").inc(nbytes)
+    telemetry.counter("collectives.wire_bytes").inc(
+        rec.get("wire_nbytes", nbytes))
     if _dispatch_hooks:
         with _hook_lock:
             hooks = list(_dispatch_hooks)

@@ -38,6 +38,7 @@ from ..graph_eval import eval_symbol
 from ..context import Context, cpu
 from .. import ndarray as nd_mod
 from .. import resilience
+from .. import telemetry
 from ..ndarray import NDArray, array as nd_array
 from .mesh import (DATA_AXIS, SEQ_AXIS, batch_sharding, data_parallel_mesh,
                    default_mesh, replicated)
@@ -1512,7 +1513,8 @@ class ShardedTrainer:
             "(donate_argnums: params, aux, opt_state)")
         # scope the mesh so mesh-aware ops (RingAttention) pick up the seq
         # axis when this step traces
-        with default_mesh(self.mesh), self._precision_scope():
+        with telemetry.span("step.dispatch", step=self._num_update), \
+                default_mesh(self.mesh), self._precision_scope():
             fn = self._aot_or_jit("train", self._train_step)
             if self._resil is not None:
                 (self._params, self._aux, self._opt_state, heads,
@@ -1542,11 +1544,13 @@ class ShardedTrainer:
             except (TypeError, ValueError) as e:
                 self._aot.pop(kind, None)
                 self.aot_stats["fallbacks"] += 1
+                telemetry.counter("trainer.aot_fallbacks").inc()
                 self.logger.warning(
                     "AOT program %r does not match this call (%s); "
                     "falling back to jit", kind, e)
                 return jit_fn(*args)
             self.aot_stats["hits"] += 1
+            telemetry.counter("trainer.aot_hits").inc()
             return out
         return dispatch
 
@@ -1569,7 +1573,8 @@ class ShardedTrainer:
         nd_mod.note_donation(
             f"ShardedTrainer.step #{self._num_update} "
             "(donate_argnums: params, aux, opt_state)")
-        with default_mesh(self.mesh), self._precision_scope():
+        with telemetry.span("step.dispatch", step=self._num_update), \
+                default_mesh(self.mesh), self._precision_scope():
             fn = self._aot_or_jit("train_acc", self._train_step_acc)
             if self._resil is not None:
                 (self._params, self._aux, self._opt_state, heads, carry,
@@ -1779,9 +1784,10 @@ class ShardedTrainer:
         on-device window.  Reading them here never resets anything."""
         if self._guard_state is None:
             return {}
-        vals = jax.device_get(self._guard_state)
+        with telemetry.span("guard.drain"):  # the one periodic device wait
+            vals = jax.device_get(self._guard_state)
         base = self._resil_base
-        return {
+        stats = {
             "skipped_steps": base["skipped"] + int(vals["skipped"]),
             "overflow_steps": base["overflows"] + int(vals["overflows"]),
             "good_steps": int(vals["good"]),
@@ -1792,6 +1798,17 @@ class ShardedTrainer:
             "rollbacks": self._rollbacks,
             "num_update": self._num_update,
         }
+        # freshest drained values double as the resilience gauges
+        g = telemetry.gauge
+        g("resilience.loss_scale").set(stats["loss_scale"])
+        g("resilience.lr_scale").set(stats["lr_scale"])
+        g("resilience.skipped_steps").set(stats["skipped_steps"])
+        g("resilience.overflow_steps").set(stats["overflow_steps"])
+        # rollbacks/backoffs already tick as counters in _sentinel_poll
+        if stats["norm_steps"] > 0:
+            g("resilience.grad_norm_mean").set(
+                stats["norm_sum"] / stats["norm_steps"])
+        return stats
 
     def _fold_guard_counters(self, stats: Dict[str, Any]) -> None:
         """Fold the windowed on-device counters into the host-side
@@ -1856,6 +1873,13 @@ class ShardedTrainer:
                 _, step = self.restore_state(manager)
             self._rollbacks += 1
             profiler.bump("resilience.rollbacks")
+            # the ring holds the steps that led INTO the divergence —
+            # dump before re-baselining overwrites the evidence
+            telemetry.dump_flight(
+                "divergence-rollback",
+                extra={"restored_step": step,
+                       "lr_scale": self._lr_scale,
+                       "norm_mean": norm_mean})
             # guard counters rolled back with the state: re-baseline
             self._resil_drained = self.resilience_stats()
             self.logger.warning(
@@ -1967,6 +1991,10 @@ class ShardedTrainer:
         am.carry_init = lambda: jax.device_put(jnp.int32(0), carry_sh)
         check_every = (self._resil.check_every if self._resil is not None
                        else 0)
+        # flight-recorder clock: wall time between successive dispatch
+        # returns — host-observable step cadence with NO device fetch
+        # (a fetch here would serialize the async pipeline)
+        t_last = time.perf_counter()
         try:
             for epoch in range(begin_epoch, num_epoch):
                 tic = time.time()
@@ -1995,6 +2023,22 @@ class ShardedTrainer:
                                 else prefetch.current_source.label)
                         am.update_async(lbls, outs)
                     nbatch += 1
+                    t_now = time.perf_counter()
+                    drained = self._resil_drained
+                    telemetry.record_step({
+                        "step": self._num_update, "epoch": epoch,
+                        "nbatch": nbatch,
+                        "host_ms": (t_now - t_last) * 1e3,
+                        "lr_scale": self._lr_scale,
+                        "loss_scale": drained.get("loss_scale"),
+                        "skipped_steps": drained.get("skipped_steps"),
+                        "grad_norm_mean": (
+                            drained["norm_sum"] / drained["norm_steps"]
+                            if drained.get("norm_steps") else None),
+                        "rollbacks": self._rollbacks,
+                        "aot_hits": self.aot_stats["hits"],
+                    })
+                    t_last = t_now
                     if batch_end_callback is not None:
                         from ..model import BatchEndParam
                         batch_end_callback(BatchEndParam(
@@ -2029,6 +2073,10 @@ class ShardedTrainer:
                         "rollbacks=%d loss-scale=%g lr-scale=%g",
                         epoch, rs["skipped_steps"], rs["overflow_steps"],
                         rs["rollbacks"], rs["loss_scale"], rs["lr_scale"])
+                    telemetry.emit("resilience", {"epoch": epoch, **rs})
+                # epoch boundary: force a metrics row so even sub-
+                # interval runs leave a diffable JSONL stream
+                telemetry.flush_metrics()
                 if epoch_end_callback is not None:
                     arg_p, aux_p = self.get_params()
                     epoch_end_callback(epoch, self.symbol, arg_p, aux_p)
@@ -2037,6 +2085,11 @@ class ShardedTrainer:
                     for name, value in [m.get()]:
                         self.logger.info("Epoch[%d] Mesh-Validation-%s=%s",
                                          epoch, name, value)
+        except Exception:
+            # the ring holds the last N steps leading into the failure;
+            # dump before the stack unwinds past whoever catches this
+            telemetry.dump_flight("step-exception")
+            raise
         finally:
             # an abandoned/preempted epoch must not leave the prefetch
             # thread alive holding staged device buffers
@@ -2166,12 +2219,16 @@ class _AsyncMetric:
     def _drain(self):
         if self._on_device:
             if self._dev_sum is not None:
-                self.inner.sum_metric += int(np.asarray(self._dev_sum))
+                with telemetry.span("metric.drain", fused=True):
+                    self.inner.sum_metric += int(np.asarray(self._dev_sum))
                 self.inner.num_inst += self._dev_num
                 self._dev_sum = None
                 self._dev_num = 0
             return
-        for labels, outs in self._buf:
-            self.inner.update([np.asarray(l) for l in labels],
-                              [NDArray(np.asarray(o)) for o in outs])
-        self._buf.clear()
+        if not self._buf:
+            return
+        with telemetry.span("metric.drain", batches=len(self._buf)):
+            for labels, outs in self._buf:
+                self.inner.update([np.asarray(l) for l in labels],
+                                  [NDArray(np.asarray(o)) for o in outs])
+            self._buf.clear()

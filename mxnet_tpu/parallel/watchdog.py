@@ -34,17 +34,24 @@ from __future__ import annotations
 
 import logging
 import os
+import select
 import socket
 import struct
 import threading
 import time
 from typing import Callable, Optional, Tuple
 
+from .. import telemetry
+
 __all__ = ["Watchdog"]
 
 log = logging.getLogger(__name__)
 
 _MAGIC = b"MXWD1"
+# monitor->peer beat acknowledgement (same 10-byte frame as the abort
+# broadcast so the peer's fixed-size reader stays message-aligned);
+# peers that predate acks ignore unknown types by design
+_ACK = b"K"
 
 
 def _default_on_failure(dead_rank: int) -> None:
@@ -156,6 +163,11 @@ class Watchdog:
             # reset with the peer re-registering within the grace window.
             # A truly dead peer stops beating, so last_seen ages past
             # `timeout` and stale_loop fires either way.
+            telemetry.name_thread(f"watchdog-beat[{peer}]")
+            gap_g = telemetry.gauge("watchdog.beat_gap_seconds")
+            missed_c = telemetry.counter("watchdog.missed_beats")
+            ack = _MAGIC + _ACK + struct.pack("<i", self.rank)
+            label = str(peer)
             while not self._stop.is_set():
                 try:
                     b = conn.recv(1)
@@ -165,9 +177,30 @@ class Watchdog:
                     return
                 if self._stop.is_set() or not b:
                     return
+                now = time.monotonic()
                 with self._mon_lock:
                     if self._conns.get(peer) is conn:
-                        self._last_seen[peer] = time.monotonic()
+                        prev = self._last_seen.get(peer)
+                        self._last_seen[peer] = now
+                    else:
+                        prev = None
+                if prev is not None:
+                    gap = now - prev
+                    gap_g.set(gap, peer=label)
+                    if gap > 1.5 * self.interval:
+                        # whole intervals of silence = beats that never
+                        # arrived (per-peer health, scrape()-able long
+                        # before declare-dead)
+                        missed_c.inc(max(1, int(gap / self.interval) - 1),
+                                     peer=label)
+                # best-effort ack so the peer can measure beat RTT; a
+                # full send buffer (peer not draining) just skips it —
+                # the monitor thread must never block on a slow peer
+                try:
+                    if select.select([], [conn], [], 0)[1]:
+                        conn.send(ack)
+                except (OSError, ValueError):
+                    pass
 
         def stale_loop():
             while not self._stop.is_set():
@@ -191,6 +224,13 @@ class Watchdog:
         self._stop.set()
         log.error("watchdog monitor: rank %d missed heartbeats — "
                   "broadcasting abort", peer)
+        telemetry.counter("watchdog.deaths").inc(peer=str(peer))
+        # postmortem evidence BEFORE the abort broadcast: on_failure's
+        # default hard-exits the process half a second from now
+        telemetry.dump_flight("watchdog-peer-death",
+                              extra={"dead_rank": peer,
+                                     "rank": self.rank,
+                                     "world": self.world})
         msg = _MAGIC + b"A" + struct.pack("<i", peer)
         with self._mon_lock:
             conns = dict(self._conns)
@@ -232,6 +272,9 @@ class Watchdog:
         def serve(conn):
             """Beat/listen on one monitor connection until it drops
             ('lost') or an abort arrives ('done')."""
+            telemetry.name_thread(f"watchdog-peer[{self.rank}]")
+            rtt_g = telemetry.gauge("watchdog.beat_rtt_seconds")
+            label = str(self.rank)
             last_beat = 0.0
             while not self._stop.is_set():
                 now = time.monotonic()
@@ -247,13 +290,19 @@ class Watchdog:
                     return "lost"
                 if data is None:
                     continue
-                if (data[:len(_MAGIC)] == _MAGIC
-                        and data[len(_MAGIC):len(_MAGIC) + 1] == b"A"):
+                kind = data[len(_MAGIC):len(_MAGIC) + 1]
+                if data[:len(_MAGIC)] != _MAGIC:
+                    continue
+                if kind == b"A":
                     (dead,) = struct.unpack("<i", data[len(_MAGIC) + 1:])
                     if not self._stop.is_set():
                         self._stop.set()
                         self.on_failure(dead)
                     return "done"
+                if kind == _ACK:
+                    # monitor acked our most recent beat: send->ack
+                    # round trip through the monitor's beat thread
+                    rtt_g.set(time.monotonic() - last_beat, rank=label)
             return "done"
 
         def peer_loop():

@@ -111,6 +111,9 @@ def record_compile(label: str, seconds: float, source: str = "compile",
                                 "seconds": float(seconds),
                                 "source": str(source),
                                 "digest": str(digest)})
+    from . import telemetry
+    telemetry.counter("compile.events").inc(source=str(source))
+    telemetry.histogram("compile.seconds").observe(float(seconds))
 
 
 def compile_events() -> List[Dict[str, object]]:
@@ -164,6 +167,10 @@ def record_audit(program: str, findings: int, seconds: float) -> None:
         _audit_events.append({"program": str(program),
                               "findings": int(findings),
                               "seconds": float(seconds)})
+    from . import telemetry
+    telemetry.counter("audit.programs").inc()
+    if findings:
+        telemetry.counter("audit.findings").inc(int(findings))
 
 
 def audit_events() -> List[Dict[str, object]]:
@@ -185,33 +192,35 @@ def reset_audit_events() -> None:
 # resilience tier produces (skipped steps, prefetch retries, corrupt
 # records, rollbacks).  Dotted names namespace the producer, e.g.
 # ``io.prefetch_retries``.  Cheap enough to bump from worker threads.
-
-_counters: Dict[str, int] = {}
-_counter_lock = threading.Lock()
+#
+# These are now a thin shim over the unified telemetry registry
+# (``mxnet_tpu.telemetry`` — docs/observability.md): every ``bump``
+# lands in a registry counter of the same name, so the metrics JSONL
+# stream, ``telemetry.scrape()``, and flight-recorder dumps all see
+# them with zero changes at the call sites.
 
 
 def bump(name: str, n: int = 1) -> None:
     """Increment counter ``name`` by ``n`` (created at 0)."""
-    with _counter_lock:
-        _counters[name] = _counters.get(name, 0) + int(n)
+    from . import telemetry
+    telemetry.counter(name).inc(int(n))
 
 
 def counter(name: str) -> int:
-    with _counter_lock:
-        return _counters.get(name, 0)
+    from . import telemetry
+    v = telemetry.registry().get_value(name)
+    return int(v) if v is not None else 0
 
 
 def counters(prefix: str = "") -> Dict[str, int]:
     """Snapshot of counters, optionally filtered by dotted prefix."""
-    with _counter_lock:
-        return {k: v for k, v in _counters.items()
-                if k.startswith(prefix)}
+    from . import telemetry
+    return telemetry.registry().counters_with_prefix(prefix)
 
 
 def reset_counters(prefix: str = "") -> None:
-    with _counter_lock:
-        for k in [k for k in _counters if k.startswith(prefix)]:
-            del _counters[k]
+    from . import telemetry
+    telemetry.registry().reset(prefix, kinds=("counter",))
 
 
 # ---------------------------------------------------------------------------

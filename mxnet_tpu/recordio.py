@@ -317,8 +317,13 @@ class MXRecordIO:
         when the corruption runs to EOF."""
         self.corrupt_count += 1
         try:
-            from . import profiler
+            from . import profiler, telemetry
             profiler.bump("recordio.corrupt_records")
+            # the counter says HOW MANY; the event row says WHERE, which
+            # is what a postmortem actually needs
+            telemetry.emit("event", {"event": "recordio-corrupt",
+                                     "uri": self.uri,
+                                     "count": self.corrupt_count})
         except Exception:
             pass
         if not self._warned_corrupt:

@@ -340,15 +340,19 @@ class DivergenceSentinel(object):
                 self.history.append(norm_mean)
             return None
         self.anomaly_streak += 1
+        from . import telemetry
+        telemetry.gauge("sentinel.anomaly_streak").set(self.anomaly_streak)
         if self.anomaly_streak >= self.cfg.rollback_after:
             self.anomaly_streak = 0
             self.cooldown = self.cfg.cooldown
             self.history.clear()
             self.rollbacks += 1
+            telemetry.counter("sentinel.rollbacks").inc()
             self.logger.warning("Resilience sentinel: %s -> rollback",
                                 reason)
             return "rollback"
         self.backoffs += 1
+        telemetry.counter("sentinel.backoffs").inc()
         self.logger.warning("Resilience sentinel: %s -> LR backoff",
                             reason)
         return "backoff"

@@ -1,0 +1,469 @@
+"""Unified telemetry plane: metrics registry, span tracer, flight recorder.
+
+The contracts under test:
+
+* registry snapshot / delta semantics are exact (counters, labels,
+  histogram flattening) and the profiler shim round-trips through it;
+* an instrumented FC train exports a structurally valid Perfetto trace:
+  spans properly nested per track, with the prefetch thread and the
+  async checkpoint writer on their own tids, and a metrics JSONL
+  stream carrying the step / guard / checkpoint core set that
+  ``tools/parse_log.py --diff-metrics`` can consume;
+* the flight recorder auto-dumps on divergence rollback, on an
+  injected chaos pipeline crash, and never writes unless a dump dir
+  was configured;
+* telemetry disabled vs fully enabled is BITWISE neutral: identical
+  params, zero extra retraces (``assert_steady_state``);
+* enabling every channel adds <2% to the fit step loop (pinned via an
+  op-count x primitive-cost budget — robust to wall-clock noise).
+"""
+import glob
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import profiler, telemetry
+from mxnet_tpu.checkpoint import CheckpointManager
+from mxnet_tpu.io import NDArrayIter
+from mxnet_tpu.parallel import ShardedTrainer, data_parallel_mesh
+from mxnet_tpu.telemetry import Registry, delta
+
+TOOLS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools")
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    telemetry.reset_for_tests()
+    yield
+    telemetry.reset_for_tests()
+
+
+def _mlp(hidden=16):
+    data = mx.symbol.Variable("data")
+    fc1 = mx.symbol.FullyConnected(data=data, name="fc1",
+                                   num_hidden=hidden)
+    act = mx.symbol.Activation(data=fc1, name="relu1", act_type="relu")
+    fc2 = mx.symbol.FullyConnected(data=act, name="fc2", num_hidden=4)
+    return mx.symbol.SoftmaxOutput(data=fc2, name="softmax")
+
+
+def _trainer(seed=7, hidden=16, feat=8, **kw):
+    mx.random.seed(seed)
+    kw.setdefault("optimizer", "sgd")
+    kw.setdefault("optimizer_params", {"learning_rate": 0.1})
+    kw.setdefault("mesh", data_parallel_mesh())
+    tr = ShardedTrainer(_mlp(hidden), **kw)
+    tr.bind({"data": (32, feat)}, {"softmax_label": (32,)})
+    return tr
+
+
+def _toy_data(n=128, feat=8, seed=0, scale=1.0):
+    rs = np.random.RandomState(seed)
+    x = (rs.randn(n, feat) * scale).astype(np.float32)
+    y = (rs.rand(n) * 4).astype(np.float32)
+    return x, y
+
+
+def _params_np(tr):
+    return {n: v.asnumpy().copy() for n, v in tr.get_params()[0].items()}
+
+
+# ---------------------------------------------------------------------------
+# Registry units
+# ---------------------------------------------------------------------------
+
+
+def test_registry_kinds_and_labels():
+    r = Registry()
+    r.counter("ev").inc()
+    r.counter("ev").inc(3, kind="late")
+    r.gauge("depth").set(7.5)
+    r.histogram("lat_ms").observe(2.0)
+    r.histogram("lat_ms").observe(40.0)
+    flat = r.flat()
+    assert flat["ev"] == 1
+    assert flat["ev{kind=late}"] == 3
+    assert flat["depth"] == 7.5
+    assert flat["lat_ms.count"] == 2
+    assert flat["lat_ms.sum"] == 42.0
+    assert flat["lat_ms.min"] == 2.0 and flat["lat_ms.max"] == 40.0
+    snap = r.snapshot()
+    assert snap["ev"]["kind"] == "counter"
+    hseries = snap["lat_ms"]["series"][0]
+    assert hseries["count"] == 2 and sum(hseries["buckets"].values()) == 2
+    assert r.get_value("ev", kind="late") == 3
+    assert r.get_value("never") is None
+    with pytest.raises(TypeError):
+        r.gauge("ev")  # kind collision is an error, not a silent merge
+
+
+def test_snapshot_delta_exact():
+    r = Registry()
+    c = r.counter("step.count")
+    h = r.histogram("step.ms")
+    c.inc(5)
+    h.observe(10.0)
+    before = r.flat()
+    for _ in range(10):
+        c.inc()
+    h.observe(30.0)
+    d = delta(r.flat(), before)
+    assert d["step.count"] == 10.0
+    assert d["step.ms.count"] == 1
+    assert d["step.ms.sum"] == 30.0
+    assert "step.ms.min" not in d  # unchanged keys drop out
+    assert delta(before, before) == {}
+
+
+def test_profiler_shim_roundtrip():
+    profiler.reset_counters("shim.")
+    profiler.bump("shim.a")
+    profiler.bump("shim.a", 4)
+    profiler.bump("shim.b")
+    assert profiler.counter("shim.a") == 5
+    assert profiler.counters("shim.") == {"shim.a": 5, "shim.b": 1}
+    # the same series is visible through the registry...
+    assert telemetry.registry().get_value("shim.a") == 5
+    profiler.reset_counters("shim.")
+    assert profiler.counters("shim.") == {}
+    # ...and a counter reset must not sweep gauges (old semantics)
+    telemetry.gauge("shim.g").set(3.0)
+    profiler.reset_counters("shim.")
+    assert telemetry.registry().get_value("shim.g") == 3.0
+
+
+def test_emitter_jsonl_and_scrape(tmp_path):
+    mfile = str(tmp_path / "m.jsonl")
+    telemetry.configure(metrics_file=mfile, metrics_interval=0.001)
+    telemetry.counter("t.ev").inc(2)
+    telemetry.emit("event", {"event": "hello"})
+    telemetry.flush_metrics()
+    rows = [json.loads(l) for l in open(mfile)]
+    kinds = [r["kind"] for r in rows]
+    assert "event" in kinds and "metrics" in kinds
+    snap = [r for r in rows if r["kind"] == "metrics"][-1]["metrics"]
+    assert snap["t.ev"] == 2
+    assert all("ts" in r and "pid" in r for r in rows)
+    assert telemetry.scrape()["t.ev"]["kind"] == "counter"
+
+
+# ---------------------------------------------------------------------------
+# Tracer units
+# ---------------------------------------------------------------------------
+
+
+def test_trace_nesting_and_cross_thread(tmp_path):
+    tpath = str(tmp_path / "t.json")
+    telemetry.configure(trace=tpath)
+
+    with telemetry.span("outer", step=1):
+        with telemetry.span("inner"):
+            telemetry.annotate(extra="yes")
+
+    def bg():
+        telemetry.name_thread("bg-worker")
+        with telemetry.span("bg.span"):
+            time.sleep(0.001)
+
+    t = threading.Thread(target=bg)
+    t.start()
+    t.join()
+    assert telemetry.export_trace() == tpath
+    info = telemetry.validate_trace(tpath)
+    assert {"outer", "inner", "bg.span"} <= info["span_names"]
+    assert "bg-worker" in info["tracks"].values()
+    # inner carries the annotation and a parent pointer to outer
+    evs = json.load(open(tpath))["traceEvents"]
+    inner = next(e for e in evs if e.get("name") == "inner")
+    outer = next(e for e in evs if e.get("name") == "outer")
+    assert inner["args"]["parent"] == outer["args"]["id"]
+    assert inner["args"]["extra"] == "yes"
+    assert inner["tid"] != next(
+        e for e in evs if e.get("name") == "bg.span")["tid"]
+
+
+def test_trace_validate_rejects_overlap(tmp_path):
+    bad = {"traceEvents": [
+        {"name": "a", "ph": "X", "ts": 0, "dur": 10, "pid": 1, "tid": 1,
+         "args": {"id": 1}},
+        {"name": "b", "ph": "X", "ts": 5, "dur": 10, "pid": 1, "tid": 1,
+         "args": {"id": 2}},
+    ]}
+    p = str(tmp_path / "bad.json")
+    json.dump(bad, open(p, "w"))
+    with pytest.raises(ValueError, match="overlap"):
+        telemetry.validate_trace(p)
+
+
+def test_span_disabled_is_shared_null():
+    s1 = telemetry.span("x")
+    s2 = telemetry.span("y", a=1)
+    assert s1 is s2  # no allocation on the disabled path
+    with s1:
+        telemetry.annotate(b=2)  # no-op, must not raise
+
+
+# ---------------------------------------------------------------------------
+# Instrumented train: trace tracks + metrics stream
+# ---------------------------------------------------------------------------
+
+
+def test_fit_trace_and_metrics_stream(tmp_path):
+    mfile = str(tmp_path / "metrics.jsonl")
+    tfile = str(tmp_path / "trace.json")
+    telemetry.configure(metrics_file=mfile, metrics_interval=0.001,
+                        trace=tfile)
+    x, y = _toy_data(n=128)
+    train = NDArrayIter(x, y, batch_size=32)
+    tr = _trainer(guard=True)
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), save_interval_steps=3,
+                            async_write=True)
+    tr.fit(train, num_epoch=2, checkpoint_manager=mgr)
+    mgr.close()
+
+    # --- trace: schema-valid, tracks cover the three required lanes
+    assert telemetry.export_trace() == tfile
+    info = telemetry.validate_trace(tfile)
+    assert {"step.dispatch", "prefetch.batch", "ckpt.snapshot",
+            "ckpt.write", "guard.drain"} <= info["span_names"]
+    lanes = set(info["tracks"].values())
+    assert "prefetch" in lanes and "ckpt-writer" in lanes
+    evs = json.load(open(tfile))["traceEvents"]
+    tid_of = lambda name: {e["tid"] for e in evs if e.get("name") == name}
+    # prefetch and the checkpoint writer each live on their own track,
+    # distinct from the dispatching thread
+    assert tid_of("prefetch.batch").isdisjoint(tid_of("step.dispatch"))
+    assert tid_of("ckpt.write").isdisjoint(tid_of("step.dispatch"))
+
+    # --- metrics stream: step rows + core series in the final snapshot
+    rows = [json.loads(l) for l in open(mfile)]
+    kinds = {r["kind"] for r in rows}
+    assert {"metrics", "step", "resilience"} <= kinds
+    steps = [r for r in rows if r["kind"] == "step"]
+    assert steps and all("host_ms" in r and "step" in r for r in steps)
+    snap = [r for r in rows if r["kind"] == "metrics"][-1]["metrics"]
+    assert snap["step.count"] == 8  # 2 epochs x 4 batches
+    assert snap["step.host_ms.count"] == 8
+    assert snap["ckpt.saves"] >= 1 and snap["ckpt.bytes"] > 0
+    assert "resilience.loss_scale" in snap
+    assert "resilience.skipped_steps" in snap
+
+    # --- the diff tool consumes the stream end to end
+    out = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "parse_log.py"),
+         "--diff-metrics", mfile, mfile],
+        capture_output=True, text=True)
+    assert out.returncode == 0, out.stderr
+    assert "step_ms_mean" in out.stdout
+    assert "resilience.loss_scale" in out.stdout
+
+
+def test_scattered_stats_absorbed():
+    """The pre-telemetry stat surfaces (compile-cache stats, collective
+    dispatch/byte counts) mirror into the one registry as they tick."""
+    from mxnet_tpu.compile_cache import CacheKey, ProgramCache
+    cache = ProgramCache()
+    key = CacheKey({"graph": "g", "avals": "a"})
+    cache.get_or_compile(key, lambda: object(), label="t")
+    cache.get_or_compile(key, lambda: object(), label="t")
+    flat = telemetry.snapshot_flat()
+    assert flat["compile_cache.misses"] == cache.stats["misses"] == 1
+    assert flat["compile_cache.memory_hits"] == 1
+    assert flat["compile.events{source=compile}"] == 1  # record_compile
+
+    import jax
+    kv = mx.kvstore.create("local")
+    kv.init("w", mx.nd.zeros((8, 4)))
+    devs = jax.devices()[:2]
+    grads = [mx.nd.NDArray(jax.device_put(
+        np.ones((8, 4), np.float32), d)) for d in devs]
+    kv.push("w", grads)
+    out = mx.nd.zeros((8, 4))
+    kv.pull("w", out=out)
+    flat = telemetry.snapshot_flat()
+    assert flat["collectives.dispatches"] >= 1
+    assert flat["collectives.bytes"] >= 8 * 4 * 4
+    assert flat["collectives.wire_bytes"] >= 8 * 4 * 4
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder
+# ---------------------------------------------------------------------------
+
+
+def test_flight_dump_on_divergence_rollback(tmp_path):
+    frdir = str(tmp_path / "fr")
+    telemetry.configure(flightrec_dir=frdir)
+    gp = {"check_every": 1, "window": 8, "min_history": 2,
+          "spike_factor": 4.0, "rollback_after": 2, "cooldown": 1}
+    tr = _trainer(guard=True, guard_params=gp)
+    x, y = _toy_data(n=32, seed=8, scale=0.1)
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    for i in range(4):
+        tr.step({"data": x, "softmax_label": y})
+        telemetry.record_step({"step": tr._num_update})
+        assert tr._sentinel_poll(mgr) is None
+    tr.save_state(mgr)
+    mgr.wait_until_finished()
+    good_step = tr._num_update
+
+    xs = x * 1e4  # finite grad-norm spike
+    tr.step({"data": xs, "softmax_label": y})
+    assert tr._sentinel_poll(mgr) == "backoff"
+    tr.step({"data": xs, "softmax_label": y})
+    assert tr._sentinel_poll(mgr) == "rollback"
+    mgr.close()
+
+    dumps = glob.glob(os.path.join(frdir,
+                                   "flightrec-divergence-rollback-*.json"))
+    assert len(dumps) == 1
+    doc = json.load(open(dumps[0]))
+    assert doc["reason"] == "divergence-rollback"
+    assert doc["extra"]["restored_step"] == good_step
+    assert len(doc["records"]) == 4  # the ring leading into the failure
+    assert doc["metrics"]["flight.dumps{reason=divergence-rollback}"] == 1
+
+
+def test_flight_dump_on_chaos_crash(tmp_path, monkeypatch):
+    """An injected pipeline crash that exhausts the prefetch retries
+    surfaces in fit(), and the step-exception hook dumps the ring."""
+    frdir = str(tmp_path / "fr")
+    telemetry.configure(flightrec_dir=frdir)
+    monkeypatch.setenv("MXNET_TPU_CHAOS", "crash:2,3,4")
+    monkeypatch.setenv("MXNET_TPU_PREFETCH_RETRIES", "2")
+    x, y = _toy_data(n=128)
+    tr = _trainer()
+    with pytest.raises(Exception, match="chaos"):
+        tr.fit(NDArrayIter(x, y, batch_size=32), num_epoch=1)
+    dumps = glob.glob(os.path.join(frdir,
+                                   "flightrec-step-exception-*.json"))
+    assert len(dumps) == 1
+    doc = json.load(open(dumps[0]))
+    # the steps that DID run are in the ring
+    assert [r["nbatch"] for r in doc["records"]] == [1, 2]
+
+
+def test_flight_no_dump_dir_never_writes(tmp_path, monkeypatch):
+    """Without MXNET_TPU_FLIGHTREC the ring records but dumps write
+    nothing — chaos tests must not litter the working directory."""
+    monkeypatch.chdir(tmp_path)
+    telemetry.record_step({"step": 1})
+    assert telemetry.dump_flight("test-reason") is None
+    assert list(tmp_path.iterdir()) == []
+    assert telemetry.flight_recorder().records() == [{"step": 1}]
+    # an explicit path always writes, dir or no dir
+    p = str(tmp_path / "explicit.json")
+    assert telemetry.dump_flight("test-reason", path=p) == p
+    assert json.load(open(p))["records"] == [{"step": 1}]
+
+
+def test_flightrec_capacity_spec():
+    telemetry.configure(flightrec_dir="/tmp/fr", flightrec_capacity=4)
+    fr = telemetry.flight_recorder()
+    for i in range(10):
+        fr.record({"i": i})
+    assert [r["i"] for r in fr.records()] == [6, 7, 8, 9]
+
+
+# ---------------------------------------------------------------------------
+# Neutrality + overhead pins
+# ---------------------------------------------------------------------------
+
+
+def test_telemetry_off_vs_on_bitwise_neutral(tmp_path):
+    """Every channel enabled changes NOTHING about the computation:
+    params bitwise identical, zero extra retraces."""
+    x, y = _toy_data(n=128, seed=3)
+
+    def run(enable):
+        telemetry.reset_for_tests()
+        if enable:
+            telemetry.configure(
+                metrics_file=str(tmp_path / "m.jsonl"),
+                metrics_interval=0.001,
+                trace=str(tmp_path / "t.json"),
+                flightrec_dir=str(tmp_path / "fr"))
+        tr = _trainer(guard=True)
+        tr.fit(NDArrayIter(x, y, batch_size=32), num_epoch=2)
+        tr.assert_steady_state()
+        return _params_np(tr), dict(tr.trace_counts)
+
+    p_off, traces_off = run(False)
+    p_on, traces_on = run(True)
+    assert traces_on == traces_off  # telemetry added no retraces
+    assert set(p_on) == set(p_off)
+    for n in p_off:
+        assert np.array_equal(p_off[n], p_on[n]), n
+
+
+@pytest.mark.slow
+def test_telemetry_overhead_under_2pct(tmp_path):
+    """Pinned: full telemetry (metrics JSONL + tracer + flight ring) adds
+    <2%% to the fit step loop.  A/B wall-clock comparison is hopeless at
+    this scale (the 2%% margin is ~2ms/epoch, below run-to-run noise on
+    the shared 8-device CPU mesh), so pin the *budget* instead: count
+    the telemetry operations one instrumented epoch actually performs
+    (spans from the trace export, one record_step + ring append per
+    batch), price them with tight-loop primitive costs measured in this
+    process, and require the product to stay under 2%% of the measured
+    epoch time.  Both factors are stable: primitive costs amortize over
+    100k iterations and the epoch time only enters as the denominator
+    with ~4x headroom."""
+    x, y = _toy_data(n=32 * 40, feat=64, seed=5)
+    train = NDArrayIter(x, y, batch_size=32)
+    tr = _trainer(hidden=256, feat=64)
+
+    def one_epoch():
+        train.reset()
+        t0 = time.perf_counter()
+        tr.fit(train, num_epoch=1)
+        return time.perf_counter() - t0
+
+    one_epoch()  # compile + warm every cache
+
+    # instrumented epoch: harvest the op counts telemetry really does
+    telemetry.reset_for_tests()
+    trace = tmp_path / "t.json"
+    telemetry.configure(metrics_file=str(tmp_path / "m.jsonl"),
+                        trace=str(trace))
+    one_epoch()
+    info = telemetry.validate_trace(telemetry.export_trace())
+    n_spans = info["events"]
+    snap = telemetry.snapshot_flat()
+    n_steps = int(snap.get("step.count", 0))
+    assert n_spans >= n_steps > 0  # sanity: epoch really was instrumented
+
+    # least-contended epoch time: min over a few runs, telemetry off
+    telemetry.reset_for_tests()
+    epoch_s = min(one_epoch() for _ in range(4))
+
+    # primitive unit costs, measured hot (enabled-path, worst case)
+    telemetry.reset_for_tests()
+    telemetry.configure(metrics_file=str(tmp_path / "m2.jsonl"),
+                        trace=str(tmp_path / "t2.json"))
+    reps = 100_000
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        with telemetry.span("bench.span", step=1):
+            pass
+    span_s = (time.perf_counter() - t0) / reps
+    t0 = time.perf_counter()
+    for i in range(reps):
+        telemetry.record_step({"step": i, "host_ms": 1.0})
+    record_s = (time.perf_counter() - t0) / reps
+
+    budget_s = n_spans * span_s + n_steps * record_s
+    frac = budget_s / epoch_s
+    assert frac < 0.02, (
+        f"telemetry budget {100 * frac:.2f}% of epoch "
+        f"({n_spans} spans @ {span_s * 1e6:.2f}us + {n_steps} steps @ "
+        f"{record_s * 1e6:.2f}us = {budget_s * 1e3:.2f}ms over "
+        f"{epoch_s * 1e3:.1f}ms epoch)")

@@ -32,6 +32,19 @@ if [[ "$mode" != "--tests-only" ]]; then
     fi
 fi
 
+if [[ "$mode" != "--tests-only" ]]; then
+    # quick end-to-end check that the telemetry seams still emit: a
+    # tiny instrumented train must produce a valid Perfetto trace and
+    # a metrics stream --diff-metrics can read (docs/observability.md)
+    echo "== telemetry smoke (tools/telemetry_smoke.py) =="
+    python tools/telemetry_smoke.py
+    rc=$?
+    if [[ $rc -ne 0 ]]; then
+        echo "ci_check: telemetry smoke FAILED (rc=$rc)" >&2
+        exit "$rc"
+    fi
+fi
+
 if [[ "$mode" == "--gate-only" ]]; then
     exit 0
 fi

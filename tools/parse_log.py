@@ -21,6 +21,14 @@ every audited config present in both, the per-bucket HBM pass counts
 (reads/writes), bucket count, findings, and pass verdict — the
 regression-triage view for grad-bucket memory-traffic changes
 (docs/static_analysis.md).
+
+``--diff-metrics A.jsonl B.jsonl`` diffs two telemetry metric streams
+(``MXNET_TPU_METRICS_FILE``): the final registry snapshots' headline
+series (mean step time from the ``step.host_ms`` histogram, guard /
+sentinel counters, collective wire bytes, compile-cache hits, derived
+MFU/bandwidth gauges), plus any tee'd audit rows and per-epoch
+resilience rows — the one-command answer to "what changed between
+these two runs" (docs/observability.md).
 """
 import argparse
 import json
@@ -238,6 +246,111 @@ def diff_audits(path_a, path_b):
     return 0
 
 
+def read_metrics_stream(path):
+    """Parse a telemetry JSONL stream (``MXNET_TPU_METRICS_FILE``):
+    returns ``(final_snapshot, step_rows, resil_rows)``.  The LAST
+    ``kind=metrics`` row wins (counters are cumulative); step and
+    resilience rows are kept in order."""
+    snap = {}
+    steps, resil = [], []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if not isinstance(rec, dict):
+                continue
+            kind = rec.get("kind")
+            if kind == "metrics" and isinstance(rec.get("metrics"), dict):
+                snap = rec["metrics"]
+            elif kind == "step":
+                steps.append(rec)
+            elif kind == "resilience":
+                resil.append(rec)
+    return snap, steps, resil
+
+
+def _derive_metrics(snap):
+    """Headline series from a flat metrics snapshot: derived mean step
+    time plus the guard / wire / cache / derived-gauge families."""
+    out = {}
+    n = snap.get("step.host_ms.count")
+    if n:
+        out["step_ms_mean"] = snap["step.host_ms.sum"] / n
+    for key, val in snap.items():
+        fam = key.split(".", 1)[0].split("{", 1)[0]
+        if fam in ("step", "resilience", "sentinel", "collectives",
+                   "compile_cache", "compile", "derived", "trainer",
+                   "ckpt", "watchdog", "io", "recordio", "flight"):
+            out[key] = val
+    return out
+
+
+def diff_metrics(path_a, path_b):
+    """Diff two telemetry JSONL streams: final-snapshot headline series
+    (step time, guard counters, wire bytes, cache hits, derived
+    gauges), then any audit rows and per-epoch resilience rows the
+    streams carry."""
+    sa, steps_a, resil_a = read_metrics_stream(path_a)
+    sb, steps_b, resil_b = read_metrics_stream(path_b)
+    if not sa and not sb:
+        print("no kind=metrics snapshot rows in either stream "
+              "(MXNET_TPU_METRICS_FILE unset during the runs?)",
+              file=sys.stderr)
+        return 1
+    da, db = _derive_metrics(sa), _derive_metrics(sb)
+    keys = sorted(set(da) | set(db))
+    print(f"final metrics snapshot ({len(steps_a)} vs {len(steps_b)} "
+          "step rows)")
+    print("| series | A | B | Δ |")
+    print("|---|---|---|---|")
+    for k in keys:
+        va, vb = da.get(k), db.get(k)
+        cells = ["" if v is None else f"{v:g}" for v in (va, vb)]
+        cells.append(f"{vb - va:+g}"
+                     if va is not None and vb is not None else "")
+        print(f"| {k} | " + " | ".join(cells) + " |")
+    other = sorted((set(sa) ^ set(sb)) - set(keys))
+    if other:
+        print(f"(series present in only one stream: {other})",
+              file=sys.stderr)
+
+    # audit rows (bench.py tees them with kind=audit) share the
+    # BENCH_rNN row schema, so the audit differ applies as-is
+    if read_audits(path_a) and read_audits(path_b):
+        print("\naudit rows")
+        diff_audits(path_a, path_b)
+
+    ra = {r.get("epoch"): r for r in resil_a}
+    rb = {r.get("epoch"): r for r in resil_b}
+    epochs = sorted(set(ra) & set(rb), key=lambda e: (e is None, e))
+    if epochs:
+        keys = sorted(k for e in epochs
+                      for k in set(ra[e]) & set(rb[e])
+                      if isinstance(ra[e][k], (int, float))
+                      and not isinstance(ra[e][k], bool)
+                      and k not in ("ts", "pid", "epoch"))
+        keys = sorted(set(keys))
+        print("\nresilience rows")
+        print("| epoch | " + " | ".join(
+            f"{k} A | {k} B | Δ" for k in keys) + " |")
+        print("|" + "---|" * (1 + 3 * len(keys)))
+        for e in epochs:
+            cells = []
+            for k in keys:
+                va, vb = ra[e].get(k), rb[e].get(k)
+                cells.append("" if va is None else f"{va:g}")
+                cells.append("" if vb is None else f"{vb:g}")
+                cells.append(f"{vb - va:+g}" if None not in (va, vb)
+                             else "")
+            print(f"| {e} | " + " | ".join(cells) + " |")
+    return 0
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("logfile", nargs="?", help="default: stdin")
@@ -253,6 +366,11 @@ def main():
                     "bench.py --audit reports (reads/writes/buckets/"
                     "findings per config, B relative to A; exits 1 if "
                     "any count regressed)")
+    ap.add_argument("--diff-metrics", nargs=2, metavar=("A", "B"),
+                    help="diff two telemetry JSONL streams "
+                    "(MXNET_TPU_METRICS_FILE): headline metric series "
+                    "(step time, guard, wire bytes, cache hits), plus "
+                    "audit and resilience rows, B relative to A")
     args = ap.parse_args()
     if args.diff_profile:
         return diff_profiles(*args.diff_profile)
@@ -260,6 +378,8 @@ def main():
         return diff_resilience(*args.diff_resilience)
     if args.diff_audit:
         return diff_audits(*args.diff_audit)
+    if args.diff_metrics:
+        return diff_metrics(*args.diff_metrics)
     lines = (open(args.logfile).readlines() if args.logfile
              else sys.stdin.readlines())
     rows = parse(lines)

@@ -1,0 +1,124 @@
+#!/usr/bin/env python
+"""CI smoke for the telemetry stack (docs/observability.md).
+
+Trains a tiny FC model for two epochs with every channel enabled —
+metrics JSONL, Perfetto tracer, flight-recorder ring — then asserts:
+
+1. the exported trace validates (schema + per-track nesting) and
+   contains the core instrumented spans on their expected tracks;
+2. the metrics stream contains the core row kinds and a final
+   snapshot with the core metric families;
+3. ``tools/parse_log.py --diff-metrics`` can consume the stream
+   (diffed against itself — all deltas zero, exit 0).
+
+Exit 0 on success, 1 with a reason on any failure.  Runs on the CPU
+mesh in a few seconds; invoked by tools/ci_check.sh after the
+staticcheck gate so the instrumentation seams cannot silently rot.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault(
+    "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+CORE_SPANS = {"step.dispatch", "prefetch.batch", "metric.drain"}
+CORE_KINDS = {"metrics", "step", "resilience"}
+CORE_FAMILIES = ("step.count", "step.host_ms.count",
+                 "resilience.loss_scale")
+
+
+def fail(msg: str) -> None:
+    print(f"telemetry_smoke: FAIL: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def main() -> None:
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.io import NDArrayIter
+    from mxnet_tpu.parallel import ShardedTrainer, data_parallel_mesh
+
+    tmp = tempfile.mkdtemp(prefix="telemetry-smoke-")
+    metrics = os.path.join(tmp, "metrics.jsonl")
+    trace = os.path.join(tmp, "trace.json")
+    telemetry.reset_for_tests()
+    telemetry.configure(metrics_file=metrics, metrics_interval=0.001,
+                        trace=trace,
+                        flightrec_dir=os.path.join(tmp, "flightrec"))
+
+    data = mx.symbol.Variable("data")
+    net = mx.symbol.FullyConnected(data=data, num_hidden=16, name="fc1")
+    net = mx.symbol.Activation(data=net, act_type="relu", name="relu1")
+    net = mx.symbol.FullyConnected(data=net, num_hidden=4, name="fc2")
+    net = mx.symbol.SoftmaxOutput(data=net, name="softmax")
+
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((64, 8)).astype(np.float32)
+    y = rng.integers(0, 4, size=(64,)).astype(np.float32)
+
+    mx.random.seed(0)
+    tr = ShardedTrainer(net, optimizer="sgd",
+                        optimizer_params={"learning_rate": 0.05},
+                        mesh=data_parallel_mesh(), guard=True)
+    tr.bind({"data": (16, 8)}, {"softmax_label": (16,)})
+    tr.fit(NDArrayIter(x, y, batch_size=16), num_epoch=2)
+    telemetry.flush_metrics()
+    path = telemetry.export_trace()
+
+    # 1. trace: valid + the core spans landed on their tracks
+    info = telemetry.validate_trace(path)
+    if info["events"] <= 0:
+        fail("trace exported no events")
+    missing = CORE_SPANS - set(info["span_names"])
+    if missing:
+        fail(f"trace missing core spans {sorted(missing)} "
+             f"(have {sorted(info['span_names'])})")
+    lanes = set(info["tracks"].values())
+    if "prefetch" not in lanes:
+        fail(f"no prefetch track in {sorted(lanes)}")
+
+    # 2. metrics stream: core kinds + final snapshot families
+    kinds, snap = set(), {}
+    with open(metrics, encoding="utf-8") as f:
+        for line in f:
+            row = json.loads(line)
+            kinds.add(row.get("kind"))
+            if row.get("kind") == "metrics":
+                snap = row["metrics"]
+    if not CORE_KINDS <= kinds:
+        fail(f"metrics stream kinds {sorted(kinds)} missing "
+             f"{sorted(CORE_KINDS - kinds)}")
+    for fam in CORE_FAMILIES:
+        if not snap.get(fam):
+            fail(f"final snapshot missing/zero {fam!r}")
+    if snap["step.count"] != 8:
+        fail(f"expected 8 steps in snapshot, got {snap['step.count']}")
+
+    # 3. the offline tool consumes the stream
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "parse_log.py"),
+         "--diff-metrics", metrics, metrics],
+        capture_output=True, text=True)
+    if proc.returncode != 0:
+        fail(f"--diff-metrics rc={proc.returncode}: {proc.stderr}")
+    if "step_ms_mean" not in proc.stdout:
+        fail("--diff-metrics output missing step_ms_mean")
+
+    print(f"telemetry_smoke: OK ({info['events']} trace events, "
+          f"{len(info['tracks'])} tracks, "
+          f"{len(snap)} metric series, dir={tmp})")
+
+
+if __name__ == "__main__":
+    main()
