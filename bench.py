@@ -857,6 +857,120 @@ def bench_twin_gap(args):
     return row
 
 
+def bench_serve(args):
+    """--serve: the serving-tier load driver (docs/serving.md).
+
+    Builds a small transformer-LM, AOT-warms two engines through the
+    compile cache — continuous batching at ``max_batch`` 8 and a
+    one-request-at-a-time baseline at ``max_batch`` 1 — then pushes the
+    same request mix (mixed prompt lengths, greedy) through both and
+    reports tokens/s plus p50/p99 per-token latency.  Acceptance
+    (ISSUE 10): continuous batching >= 2x the serial tokens/s on the
+    8-virtual-device CPU mesh, with zero decode traces after warmup.
+    Results land in ``BENCH_r10.json``; ``tools/parse_log.py
+    --diff-serve`` diffs two of these reports.
+    """
+    import jax
+    from mxnet_tpu.models.transformer import transformer_lm
+    from mxnet_tpu.serve import Engine, EngineConfig
+
+    V, NL, D, H = 512, 4, 128, 4
+    sym = transformer_lm(vocab_size=V, num_layers=NL, d_model=D, heads=H,
+                         batch_size=1, seq_len=8)
+    shapes, _, _ = sym.infer_shape(data=(1, 8), softmax_label=(1, 8))
+    rng = np.random.RandomState(0)
+    params = {n: (rng.randn(*s) * 0.05).astype(np.float32)
+              for n, s in zip(sym.list_arguments(), shapes)
+              if n not in ("data", "softmax_label")}
+
+    n_req, new_tok = args.serve_requests, args.serve_tokens
+    r = np.random.RandomState(1)
+    reqs = [(list(map(int, r.randint(1, V, int(r.randint(4, 33))))),
+             new_tok) for _ in range(n_req)]
+
+    def drive(max_batch, serial):
+        eng = Engine(params, EngineConfig(
+            heads=H, block_size=16, num_blocks=256, max_batch=max_batch,
+            max_queue=max(64, n_req), max_prompt_len=64, max_seq_len=128,
+            prompt_bucket_min=16))
+        eng.warmup()                       # AOT: timing excludes compile
+        traces_warm = dict(eng.trace_counts)
+        t0 = time.perf_counter()
+        if serial:
+            for p, m in reqs:
+                eng.result(eng.submit(p, max_new_tokens=m))
+        else:
+            for p, m in reqs:
+                eng.submit(p, max_new_tokens=m)
+            eng.run()
+        wall = time.perf_counter() - t0
+        done = list(eng.requests.values())
+        total = sum(len(q.tokens) for q in done)
+        intervals = [1e3 * (b - a) for q in done
+                     for a, b in zip(q.token_times, q.token_times[1:])]
+        ttft = [1e3 * (q.first_token_t - q.submit_t) for q in done
+                if q.first_token_t is not None]
+        return {
+            "tokens_s": total / wall,
+            "tokens": total,
+            "wall_s": wall,
+            "p50_token_ms": float(np.percentile(intervals, 50)),
+            "p99_token_ms": float(np.percentile(intervals, 99)),
+            "p50_ttft_ms": float(np.percentile(ttft, 50)),
+            "new_traces": sum(dict(eng.trace_counts).values())
+            - sum(traces_warm.values()),
+            "stats": eng.stats(),
+        }
+
+    dev = jax.devices()[0].device_kind
+    rows = []
+    results = {}
+    for label, mb, serial in (("serial max_batch=1", 1, True),
+                              ("continuous max_batch=8", 8, False)):
+        res = results[label] = drive(mb, serial)
+        rows.append({
+            "metric": f"serve {label} ({n_req} reqs x {new_tok} new "
+                      f"tokens, 4L d128, {dev})",
+            "value": round(res["tokens_s"], 1),
+            "unit": "tokens/s",
+            "vs_baseline": None,
+            "p50_token_ms": round(res["p50_token_ms"], 2),
+            "p99_token_ms": round(res["p99_token_ms"], 2),
+            "p50_ttft_ms": round(res["p50_ttft_ms"], 2),
+            "wall_s": round(res["wall_s"], 2),
+            "tokens": res["tokens"],
+            "decode_traces_after_warmup": res["new_traces"],
+            "n_devices": len(jax.devices()),
+        })
+        _emit_row(rows[-1])
+    serial_res = results["serial max_batch=1"]
+    cont = results["continuous max_batch=8"]
+    ratio = cont["tokens_s"] / serial_res["tokens_s"]
+    zero_traces = (cont["new_traces"] == 0
+                   and serial_res["new_traces"] == 0)
+    rows.append({
+        "metric": f"serve continuous-batching speedup ({n_req} reqs, "
+                  f"max_batch 8 vs 1, {dev})",
+        "value": round(ratio, 2),
+        "unit": "x tokens/s vs one-request-at-a-time",
+        "vs_baseline": None,
+        "continuous_tokens_s": round(cont["tokens_s"], 1),
+        "serial_tokens_s": round(serial_res["tokens_s"], 1),
+        "p99_token_ms": round(cont["p99_token_ms"], 2),
+        "zero_traces_after_warmup": zero_traces,
+        "target": ">= 2x, zero traces after warmup",
+        "pass": bool(ratio >= 2.0 and zero_traces),
+        "n_devices": len(jax.devices()),
+    })
+    _emit_row(rows[-1])
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "BENCH_r10.json")
+    with open(out, "w") as f:
+        json.dump(rows, f, indent=2)
+        f.write("\n")
+    return rows
+
+
 def bench_compile(args):
     """--compile: cold-start elimination (docs/perf.md r7).
 
@@ -1111,13 +1225,23 @@ def main():
                     "trainer attach through the persistent program "
                     "cache + bucketed-LM program count/bitwise parity "
                     "(docs/perf.md r7)")
+    ap.add_argument("--serve", action="store_true",
+                    help="bench the serving tier: continuous batching "
+                    "(max_batch 8) vs one-request-at-a-time through "
+                    "the paged KV-cache engine; tokens/s + p50/p99 "
+                    "per-token latency -> BENCH_r10.json "
+                    "(docs/serving.md)")
+    ap.add_argument("--serve-requests", type=_positive, default=16,
+                    help="--serve: number of requests in the load mix")
+    ap.add_argument("--serve-tokens", type=_positive, default=32,
+                    help="--serve: new tokens generated per request")
     args = ap.parse_args()
     if args.compute_dtype == "none":
         args.compute_dtype = None
     if args.grad_compression == "none":
         args.grad_compression = None
 
-    if args.compile or args.resilience or args.audit:
+    if args.compile or args.resilience or args.audit or args.serve:
         # acceptance config is the 8-virtual-device CPU mesh; only set
         # when the caller hasn't picked a platform (jax is imported
         # lazily, so this is early enough)
@@ -1128,6 +1252,8 @@ def main():
             bench_compile(args)
         elif args.audit:
             bench_audit(args)
+        elif args.serve:
+            bench_serve(args)
         else:
             bench_resilience(args)
         return 0

@@ -58,6 +58,7 @@ from . import monitor
 from .monitor import Monitor
 from . import rtc
 from . import predictor
+from . import serve
 from . import telemetry
 from . import profiler
 from . import resilience
@@ -79,5 +80,5 @@ __all__ = [
     "Initializer", "Uniform", "Normal", "Xavier", "Orthogonal", "Optimizer",
     "save_checkpoint", "load_checkpoint", "checkpoint", "CheckpointManager",
     "compile_cache", "resilience", "chaos", "analysis", "telemetry",
-    "profiler", "monitor", "Monitor",
+    "profiler", "monitor", "Monitor", "serve",
 ]

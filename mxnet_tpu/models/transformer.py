@@ -15,8 +15,12 @@ path and the per-device shards of the explicit shard_map path.
 """
 import contextlib
 
+import jax
+import jax.numpy as jnp
+
 from .. import symbol as sym
 from ..attribute import AttrScope
+from ..base import MXNetError
 
 
 def _linear(x, b, l, d_in, d_out, name, quant=""):
@@ -115,3 +119,183 @@ def transformer_lm(vocab_size=256, num_layers=2, d_model=64, heads=4,
                              out_dtype="same" if head_same_dtype else "",
                              out_mode="loss" if loss_head else "",
                              **head_kwargs)
+
+
+# ---------------------------------------------------------------------------
+# Incremental decode: the stepwise-generation head the symbol above cannot
+# express (its seq len is baked into every Reshape).  These are pure-JAX
+# functional twins of the SAME graph — each op mirrors the registered
+# symbol op exactly (FullyConnected flatten/cast/dot/bias, LayerNorm f32
+# stats + rsqrt, Embedding take, dense RingAttention short-seq path), and
+# they consume the symbol's OWN parameter dict (``layer{i}_q_weight``,
+# ``final_ln_gamma``, ...) so trained checkpoints load unchanged.  The
+# serving tier (mxnet_tpu/serve/) jits these behind compile_cache; they
+# also work standalone with the dense cache helpers below.
+# ---------------------------------------------------------------------------
+
+_LN_EPS = 1e-5   # LayerNorm op default (ops/nn_ops.py)
+
+
+def _fcm(x, weight, bias):
+    """Mirror of the FullyConnected op on [..., d_in] activations."""
+    lead = x.shape[:-1]
+    h = x.reshape((-1, x.shape[-1]))
+    if h.dtype != weight.dtype:
+        h = h.astype(weight.dtype)
+    h = jnp.dot(h, weight.T) + bias.astype(weight.dtype)
+    return h.reshape(lead + (weight.shape[0],))
+
+
+def _lnm(x, gamma, beta):
+    """Mirror of the LayerNorm op (f32 stats under AMP)."""
+    x32 = x.astype(jnp.float32) if x.dtype in (jnp.bfloat16,
+                                               jnp.float16) else x
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    xhat = (x32 - mean) * jax.lax.rsqrt(var + _LN_EPS)
+    out = xhat * gamma.astype(x32.dtype) + beta.astype(x32.dtype)
+    return out.astype(x.dtype)
+
+
+def _param(params, name):
+    try:
+        return params[name]
+    except KeyError:
+        raise MXNetError(f"transformer_lm params missing {name!r} — not a "
+                         "transformer_lm parameter dict?")
+
+
+def lm_config_from_params(params):
+    """Infer ``(vocab_size, num_layers, d_model)`` from a transformer_lm
+    parameter dict (heads is not recoverable from shapes — it must come
+    from the caller's config/manifest)."""
+    embed = _param(params, "embed_weight")
+    n = 0
+    while f"layer{n}_q_weight" in params:
+        n += 1
+    if n == 0:
+        raise MXNetError("no layer0_q_weight: not transformer_lm params")
+    return int(embed.shape[0]), n, int(embed.shape[1])
+
+
+def _block_step(params, i, h, attend):
+    """One transformer block on hidden states ``h`` ([..., d]) where
+    ``attend(q, k, v)`` maps per-head states [..., H, hd] -> attention
+    output of the same shape (the caller owns the KV story)."""
+    d = h.shape[-1]
+
+    def p(suffix):
+        return _param(params, f"layer{i}_{suffix}")
+
+    hn = _lnm(h, p("ln1_gamma"), p("ln1_beta"))
+    q, k, v = (_fcm(hn, p(f"{nm}_weight"), p(f"{nm}_bias"))
+               for nm in ("q", "k", "v"))
+    att = attend(q, k, v)
+    att = _fcm(att, p("proj_weight"), p("proj_bias"))
+    h = h + att
+    hn = _lnm(h, p("ln2_gamma"), p("ln2_beta"))
+    f = _fcm(hn, p("ffn1_weight"), p("ffn1_bias"))
+    f = jnp.maximum(f, 0)
+    return h + _fcm(f, p("ffn2_weight"), p("ffn2_bias"))
+
+
+def _lm_head(params, h):
+    h = _lnm(h, _param(params, "final_ln_gamma"),
+             _param(params, "final_ln_beta"))
+    return _fcm(h, _param(params, "lm_head_weight"),
+                _param(params, "lm_head_bias"))
+
+
+def transformer_lm_prefill(params, tokens, *, heads):
+    """Causal forward over full prompts, emitting the KV states.
+
+    ``tokens``: [B, L] ids.  Returns ``(logits [B, L, V], ks, vs)``
+    where ``ks``/``vs`` are per-layer [B, L, H, hd] states — exactly
+    what a cache (dense or paged) stores.  Attention runs the dense
+    short-sequence path the RingAttention op uses below
+    ``AUTO_SWITCH_LEN``, so logits match the symbol's teacher-forced
+    forward at the same [B, L] shape.
+    """
+    from ..parallel.ring_attention import local_attention
+    vocab, num_layers, d = lm_config_from_params(params)
+    if d % heads:
+        raise MXNetError(f"d_model {d} not divisible by heads {heads}")
+    hd = d // heads
+    b, l = tokens.shape
+    h = jnp.take(_param(params, "embed_weight"),
+                 tokens.astype(jnp.int32), axis=0)
+    ks, vs = [], []
+
+    def attend(q, k, v):
+        q, k, v = (t.reshape(b, l, heads, hd) for t in (q, k, v))
+        ks.append(k)
+        vs.append(v)
+        qt, kt, vt = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+        out = local_attention(qt, kt, vt, causal=True, block_size=None)
+        return out.transpose(0, 2, 1, 3).reshape(b, l, d)
+
+    for i in range(num_layers):
+        h = _block_step(params, i, h, attend)
+    return _lm_head(params, h), ks, vs
+
+
+def transformer_lm_decode(params, tokens, *, heads, attend):
+    """One incremental decode step over a caller-owned KV cache.
+
+    ``tokens``: [B] ids of the tokens being processed this step.
+    ``attend(layer, q, k, v)`` receives the new per-head states
+    ([B, H, hd] each), must extend the caller's cache with ``k``/``v``
+    and return ``q``'s attention over the full cached prefix (including
+    the new position) as [B, H, hd].  Returns next-token logits [B, V].
+
+    The serve tier passes a paged-cache closure
+    (``serve.kvcache.paged_attention``); :func:`transformer_lm_decode_dense`
+    below is the self-contained dense-cache form.
+    """
+    vocab, num_layers, d = lm_config_from_params(params)
+    hd = d // heads
+    b = tokens.shape[0]
+    h = jnp.take(_param(params, "embed_weight"),
+                 tokens.astype(jnp.int32), axis=0)
+
+    def make_attend(i):
+        def _attend(q, k, v):
+            q, k, v = (t.reshape(b, heads, hd) for t in (q, k, v))
+            return attend(i, q, k, v).reshape(b, d)
+        return _attend
+
+    for i in range(num_layers):
+        h = _block_step(params, i, h, make_attend(i))
+    return _lm_head(params, h)
+
+
+def transformer_lm_decode_dense(params, tokens, lengths, k_cache, v_cache,
+                                *, heads):
+    """Dense-cache decode step: consumes and extends preallocated
+    [num_layers, B, L_max, H, hd] K/V caches.
+
+    ``tokens``: [B] ids; ``lengths``: [B] entries already cached (the
+    new token is written at position ``lengths``).  Returns
+    ``(logits [B, V], k_cache', v_cache')``.  Attention is the same f32
+    masked softmax as the dense attention path, masked to
+    ``lengths + 1`` valid positions per row.
+    """
+    b = tokens.shape[0]
+    rows = jnp.arange(b)
+    cache = [k_cache, v_cache]
+    d = _param(params, "embed_weight").shape[1]
+    scale = 1.0 / jnp.sqrt(jnp.float32(d // heads))
+
+    def attend(i, q, k, v):
+        cache[0] = cache[0].at[i, rows, lengths].set(k)
+        cache[1] = cache[1].at[i, rows, lengths].set(v)
+        kc, vc = cache[0][i], cache[1][i]
+        s = (jnp.einsum("bhd,blhd->bhl", q, kc) * scale).astype(jnp.float32)
+        valid = jnp.arange(kc.shape[1])[None, :] < (lengths + 1)[:, None]
+        s = jnp.where(valid[:, None, :], s, -1e30)
+        probs = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+        return jnp.einsum("bhl,blhd->bhd", probs, vc)
+
+    logits = transformer_lm_decode(params, tokens, heads=heads,
+                                   attend=attend)
+    return logits, cache[0], cache[1]

@@ -9,14 +9,77 @@ surface as a class; the module-level helpers mirror the C calls.
 """
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence
+import os
+import re
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from .base import MXNetError
 
-__all__ = ["Predictor", "create", "load_ndarray_file",
+__all__ = ["Predictor", "create", "load_weights", "load_ndarray_file",
            "export_model", "load_exported", "ExportedPredictor"]
+
+
+def _is_manifest_dir(path: str) -> bool:
+    """A CheckpointManager root: a directory holding committed
+    ``step-NNNNNNNN/manifest.json`` checkpoints."""
+    if not os.path.isdir(path):
+        return False
+    from .checkpoint.layout import committed_steps
+    return bool(committed_steps(path))
+
+
+def load_weights(source: str, epoch: Optional[int] = None
+                 ) -> Tuple[Any, Dict[str, Any], Dict[str, Any],
+                            Dict[str, Any]]:
+    """One weight-loading story for every inference entry point
+    (``Predictor`` and ``serve.Engine.from_checkpoint``).
+
+    ``source`` may be:
+
+    * a **CheckpointManager directory** (``step-*/manifest.json``
+      layout) — loads the latest committed step, or ``epoch`` if given;
+    * a **legacy prefix** — ``prefix-symbol.json`` +
+      ``prefix-%04d.params`` (``epoch`` required, default 0);
+    * a **``.params`` file path** — the epoch is parsed from the name,
+      with the sibling ``-symbol.json`` picked up when present.
+
+    Returns ``(symbol_or_None, arg_params, aux_params, meta)`` with
+    numpy-convertible params and ``meta`` carrying ``source_kind`` and
+    ``step``/``epoch``.
+    """
+    if _is_manifest_dir(source):
+        from .checkpoint import CheckpointManager
+        mgr = CheckpointManager(source)
+        try:
+            symbol, arg_params, aux_params, step = mgr.load_model(epoch)
+        finally:
+            mgr.close()
+        return symbol, arg_params, aux_params, {
+            "source_kind": "manifest", "step": step}
+    prefix, ep = source, epoch
+    m = re.match(r"^(.*)-(\d{4,})\.params$", source)
+    if m:
+        prefix = m.group(1)
+        ep = int(m.group(2)) if epoch is None else epoch
+    if ep is None:
+        ep = 0
+    params_path = "%s-%04d.params" % (prefix, ep)
+    if not os.path.exists(params_path):
+        raise MXNetError(
+            f"{source!r}: neither a checkpoint-manifest directory nor a "
+            f"legacy checkpoint ({params_path} missing)")
+    from . import ndarray as nd
+    from .model import split_param_dict
+    arg_params, aux_params = split_param_dict(nd.load(params_path))
+    symbol = None
+    sym_path = f"{prefix}-symbol.json"
+    if os.path.exists(sym_path):
+        from . import symbol as sym_mod
+        symbol = sym_mod.load(sym_path)
+    return symbol, arg_params, aux_params, {
+        "source_kind": "legacy", "epoch": ep}
 
 
 def load_ndarray_file(blob: bytes) -> Dict[str, "np.ndarray"]:
@@ -56,7 +119,8 @@ class Predictor:
     """
 
     def __init__(self, symbol_json: str, param_blob, input_shapes,
-                 ctx=None, output_names: Optional[Sequence[str]] = None):
+                 ctx=None, output_names: Optional[Sequence[str]] = None,
+                 warmup: bool = True):
         from . import symbol as sym_mod
         from .context import default_ctx
         from .ndarray import NDArray, zeros
@@ -110,6 +174,13 @@ class Predictor:
         self._exec = symbol.bind(self._ctx, args, grad_req="null",
                                  aux_states=aux)
         self._input_names = list(input_shapes)
+        # AOT-resolve the forward program through the global
+        # compile_cache (memory/disk hit on warm restarts instead of a
+        # retrace) — the deployment path gets the same zero-trace story
+        # as the serve engine; `aot_info` records where each program
+        # came from (memory/disk/compile)
+        self.aot_info: List[Dict] = (
+            self._exec.warmup() if warmup else [])
 
     # -- the MXPred* surface -------------------------------------------
     def set_input(self, name: str, value) -> None:
@@ -141,17 +212,30 @@ class Predictor:
         self.forward()
         return [self.get_output(i) for i in range(self.num_outputs)]
 
+    def cache_stats(self) -> Dict[str, int]:
+        """Global compile-cache counters (memory_hits / disk_hits /
+        misses / puts) — how warm this deployment's programs are."""
+        from . import compile_cache as cc
+        return dict(cc.get_cache().stats)
 
-def create(prefix: str, epoch: int, input_shapes, ctx=None,
-           output_names=None) -> Predictor:
-    """Build a Predictor from checkpoint files (``prefix-symbol.json`` +
-    ``prefix-%04d.params``) — the typical deployment entry."""
-    with open(f"{prefix}-symbol.json") as f:
-        symbol_json = f.read()
-    with open("%s-%04d.params" % (prefix, epoch), "rb") as f:
-        blob = f.read()
-    return Predictor(symbol_json, blob, input_shapes, ctx=ctx,
-                     output_names=output_names)
+
+def create(prefix: str, epoch: Optional[int] = None, input_shapes=None,
+           ctx=None, output_names=None, warmup: bool = True) -> Predictor:
+    """Build a Predictor from a checkpoint — a legacy prefix
+    (``prefix-symbol.json`` + ``prefix-%04d.params``) **or** a
+    ``CheckpointManager`` directory (``step-*/manifest.json``); both go
+    through :func:`load_weights`, the story shared with
+    ``serve.Engine.from_checkpoint``."""
+    if input_shapes is None:
+        raise MXNetError("create() needs input_shapes")
+    symbol, arg_params, aux_params, _meta = load_weights(prefix, epoch)
+    if symbol is None:
+        raise MXNetError(f"{prefix!r} has no symbol json; pass a "
+                         "checkpoint that saved its symbol")
+    blob = {f"arg:{k}": v for k, v in arg_params.items()}
+    blob.update({f"aux:{k}": v for k, v in aux_params.items()})
+    return Predictor(symbol.tojson(), blob, input_shapes, ctx=ctx,
+                     output_names=output_names, warmup=warmup)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,25 @@
+"""High-throughput serving tier: continuous batching + paged KV-cache
+autoregressive inference (docs/serving.md).
+
+The inference half of the framework the training stack has been
+building toward (ROADMAP item 1): a ``transformer_lm`` checkpoint goes
+in, concurrent token streams come out.
+
+* :mod:`~mxnet_tpu.serve.kvcache` — paged/blocked KV-cache: fixed-size
+  blocks in preallocated device pools, per-request block tables,
+  alloc/free/defrag, and block-scanned paged attention.
+* :mod:`~mxnet_tpu.serve.scheduler` — continuous batching: FIFO +
+  SLO-aware admission and per-step eviction over a bounded queue.
+* :mod:`~mxnet_tpu.serve.engine` — the front-end: submit/stream/cancel,
+  greedy + temperature/top-k sampling with per-request PRNG keys,
+  prefill/decode programs AOT-warmed through
+  :mod:`~mxnet_tpu.compile_cache`, weights from ``checkpoint/``
+  manifests or legacy ``.params``.
+"""
+from . import engine, kvcache, scheduler
+from .engine import Engine, EngineConfig
+from .kvcache import BlockAllocator
+from .scheduler import Request, Scheduler
+
+__all__ = ["Engine", "EngineConfig", "BlockAllocator", "Request",
+           "Scheduler", "engine", "kvcache", "scheduler"]

@@ -1,0 +1,568 @@
+"""Serving engine: continuous batching over a paged KV-cache.
+
+The front door of the serving tier (docs/serving.md): ``submit`` /
+``stream`` / ``cancel`` plus a ``step()`` loop that, every iteration,
+
+1. evicts finished/cancelled requests (their KV blocks return to the
+   pool immediately),
+2. admits queued requests into free decode slots
+   (:class:`~mxnet_tpu.serve.scheduler.Scheduler` policy: FIFO with an
+   SLO-aware jump),
+3. **prefills** each admitted prompt through a bucket-laddered AOT
+   program (one program per padded prompt length), and
+4. runs ONE **decode** step for the whole running batch through a
+   slot-bucketed AOT program.
+
+Both program families compile through
+:mod:`~mxnet_tpu.compile_cache` (:func:`Engine.warmup` resolves every
+bucket up front — memory/disk hits on a warm restart, zero traces in
+steady state, pinned by ``tests/test_serve.py``).  Model math is the
+functional twin of the training graph
+(:func:`~mxnet_tpu.models.transformer.transformer_lm_prefill` /
+``transformer_lm_decode``) reading/writing the paged pools of
+:mod:`~mxnet_tpu.serve.kvcache`, so a checkpoint trained on the symbol
+serves unmodified — load it with :func:`Engine.from_checkpoint`
+(CheckpointManager directory or legacy ``prefix``/``.params``, the one
+weight-loading story shared with :mod:`mxnet_tpu.predictor`).
+
+Determinism: decode slots are bucketed to ``decode_buckets`` (default:
+a single bucket at ``max_batch``, so every step runs the same program
+shape — XLA:CPU gemm schedules differ per row count, docs/perf.md r7)
+and rows are independent, so a request decodes token-for-token
+identically whether it runs alone or inside a full continuously-batched
+engine.  Sampling keys are derived per (request, position), so even
+temperature>0 streams replay identically across admission orders and
+preemptions.
+"""
+from __future__ import annotations
+
+import collections
+import os
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import compile_cache as cc
+from .. import telemetry
+from ..base import MXNetError
+from ..models.transformer import (lm_config_from_params,
+                                  transformer_lm_decode,
+                                  transformer_lm_prefill)
+from . import kvcache
+from .scheduler import CANCELLED, FINISHED, Request, Scheduler
+
+__all__ = ["EngineConfig", "Engine"]
+
+_NEG = -1e30
+
+
+def _env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name, "").strip()
+    return int(raw) if raw else default
+
+
+def _env_float(name: str, default: Optional[float]) -> Optional[float]:
+    raw = os.environ.get(name, "").strip()
+    return float(raw) if raw else default
+
+
+@dataclass(frozen=True)
+class EngineConfig:
+    """Static engine geometry.  Every field is baked into program
+    shapes or pool sizes — changing one means new programs (the
+    compile-cache key includes them all via the avals/fingerprint).
+
+    ``heads`` must come from the caller (or checkpoint meta): it is the
+    one transformer_lm hyperparameter not recoverable from parameter
+    shapes.
+    """
+    heads: int = 4
+    block_size: int = 16          # kv entries per pool block
+    num_blocks: int = 128         # physical pool blocks (slot 0 = trash)
+    max_batch: int = 8            # decode slots
+    max_queue: int = 64           # bounded wait queue
+    max_prompt_len: int = 128     # top rung of the prefill ladder
+    max_seq_len: int = 256        # prompt + generated, per request
+    decode_buckets: Optional[Tuple[int, ...]] = None  # None -> (max_batch,)
+    prompt_bucket_min: int = 16
+    prompt_bucket_factor: float = 2.0
+    slo_ms: Optional[float] = None       # default per-request SLO
+    slo_admit_frac: float = 0.5
+    seed: int = 0
+    dtype: Any = jnp.float32
+
+    @classmethod
+    def from_env(cls, **overrides) -> "EngineConfig":
+        """Environment defaults (docs/env_vars.md round 11); explicit
+        kwargs win."""
+        env = dict(
+            block_size=_env_int("MXNET_TPU_SERVE_BLOCK_SIZE", 16),
+            num_blocks=_env_int("MXNET_TPU_SERVE_BLOCKS", 128),
+            max_batch=_env_int("MXNET_TPU_SERVE_MAX_BATCH", 8),
+            max_queue=_env_int("MXNET_TPU_SERVE_MAX_QUEUE", 64),
+            max_seq_len=_env_int("MXNET_TPU_SERVE_MAX_SEQ", 256),
+            slo_ms=_env_float("MXNET_TPU_SERVE_SLO_MS", None),
+        )
+        env.update(overrides)
+        return cls(**env)
+
+    def resolved_decode_buckets(self) -> Tuple[int, ...]:
+        if self.decode_buckets:
+            bs = tuple(sorted(set(int(b) for b in self.decode_buckets)))
+            if bs[-1] < self.max_batch:
+                raise MXNetError(
+                    f"decode_buckets {bs} cannot cover max_batch "
+                    f"{self.max_batch}")
+            return bs
+        return (self.max_batch,)
+
+
+class _AotProgram:
+    """AOT executable with automatic jit fallback (mirrors
+    ``executor._AotProgram``)."""
+
+    __slots__ = ("_compiled", "_jit_fn")
+
+    def __init__(self, compiled, jit_fn):
+        self._compiled = compiled
+        self._jit_fn = jit_fn
+
+    def __call__(self, *args):
+        try:
+            return self._compiled(*args)
+        except (TypeError, ValueError):
+            return self._jit_fn(*args)
+
+
+def _sample_row(logits, key, temp, topk, pos):
+    """Greedy / temperature / top-k sampling for one row.
+
+    ``pos`` keys the PRNG: the sample for (request, position) is a pure
+    function of the request key and the logits — independent of batch
+    composition, admission order, or preemption restarts.
+    """
+    logits = logits.astype(jnp.float32)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = logits / jnp.maximum(temp, 1e-6)
+    vocab = logits.shape[-1]
+    kth = jnp.flip(jnp.sort(scaled), -1)[jnp.clip(topk - 1, 0, vocab - 1)]
+    masked = jnp.where((topk > 0) & (scaled < kth), _NEG, scaled)
+    sampled = jax.random.categorical(
+        jax.random.fold_in(key, pos), masked).astype(jnp.int32)
+    return jnp.where(temp > 0, sampled, greedy)
+
+
+_sample_batch = jax.vmap(_sample_row, in_axes=(0, 0, 0, 0, 0))
+
+
+class Engine:
+    """Continuous-batching autoregressive server for ``transformer_lm``
+    parameter dicts.  See the module docstring for the step anatomy."""
+
+    def __init__(self, params: Dict[str, Any], config: EngineConfig):
+        self.config = config
+        self._params = {k: jnp.asarray(
+            v.asnumpy() if hasattr(v, "asnumpy") else v)
+            for k, v in params.items()}
+        self.vocab, self.num_layers, self.d_model = (
+            lm_config_from_params(self._params))
+        self.heads = int(config.heads)
+        if self.d_model % self.heads:
+            raise MXNetError(f"d_model {self.d_model} not divisible by "
+                             f"heads {self.heads}")
+        self.head_dim = self.d_model // self.heads
+        bs = config.block_size
+        self.max_blocks = -(-config.max_seq_len // bs)
+        self.alloc = kvcache.BlockAllocator(config.num_blocks, bs)
+        self.kpool, self.vpool = kvcache.make_pools(
+            self.num_layers, config.num_blocks, bs, self.heads,
+            self.head_dim, dtype=config.dtype)
+        self.sched = Scheduler(config.max_batch, config.max_queue,
+                               config.slo_ms, config.slo_admit_frac)
+        policy = cc.BucketPolicy(min_bucket=config.prompt_bucket_min,
+                                 factor=config.prompt_bucket_factor,
+                                 round_to=config.prompt_bucket_min)
+        self.prompt_buckets = tuple(policy._ladder(config.max_prompt_len))
+        self.decode_buckets = config.resolved_decode_buckets()
+        self._base_key = jax.random.PRNGKey(config.seed)
+        self._programs: Dict[Tuple[str, int], _AotProgram] = {}
+        self.trace_counts = collections.Counter()
+        self.aot_stats = collections.Counter()
+        self.requests: Dict[int, Request] = {}
+        self.step_idx = 0
+        self._fingerprint = (
+            f"serve:{self.vocab}:{self.num_layers}:{self.d_model}:"
+            f"{self.heads}:bs{bs}:nb{config.num_blocks}:"
+            f"mb{self.max_blocks}:{np.dtype(config.dtype).name}")
+
+    # -- weight loading ---------------------------------------------------
+
+    @classmethod
+    def from_checkpoint(cls, source: str, config: EngineConfig,
+                        epoch: Optional[int] = None) -> "Engine":
+        """Build from a CheckpointManager directory, a legacy
+        ``prefix`` (``prefix-symbol.json`` + ``prefix-%04d.params``), or
+        a ``.params`` file — :func:`mxnet_tpu.predictor.load_weights`,
+        the story shared with the deployment predictor."""
+        from ..predictor import load_weights
+        _, arg_params, _, _meta = load_weights(source, epoch)
+        return cls(arg_params, config)
+
+    # -- program construction ---------------------------------------------
+
+    def _make_prefill_fn(self, lb: int):
+        heads, nl = self.heads, self.num_layers
+
+        def fn(kpool, vpool, params, tokens, length, table_row, key,
+               temp, topk):
+            self.trace_counts[f"prefill@{lb}"] += 1
+            logits, ks, vs = transformer_lm_prefill(params, tokens,
+                                                    heads=heads)
+            for i in range(nl):
+                kpool = kvcache.write_prefill(kpool, i, ks[i][0],
+                                              table_row, length)
+                vpool = kvcache.write_prefill(vpool, i, vs[i][0],
+                                              table_row, length)
+            last = jnp.take(logits[0], length - 1, axis=0)
+            tok = _sample_row(last, key, temp, topk, length)
+            return kpool, vpool, tok
+
+        return fn
+
+    def _make_decode_fn(self, bb: int):
+        heads = self.heads
+
+        def fn(kpool, vpool, params, tokens, tables, lengths, slots,
+               offsets, active, keys, temps, topks):
+            self.trace_counts[f"decode@{bb}"] += 1
+            pools = [kpool, vpool]
+
+            def attend(i, q, k, v):
+                pools[0] = kvcache.write_decode(pools[0], i, k, slots,
+                                                offsets, active)
+                pools[1] = kvcache.write_decode(pools[1], i, v, slots,
+                                                offsets, active)
+                return kvcache.paged_attention(
+                    q, pools[0][i], pools[1][i], tables, lengths + 1)
+
+            logits = transformer_lm_decode(params, tokens, heads=heads,
+                                           attend=attend)
+            toks = _sample_batch(logits, keys, temps, topks, lengths + 1)
+            return pools[0], pools[1], toks
+
+        return fn
+
+    def _avals(self, kind: str, bucket: int):
+        sds = jax.ShapeDtypeStruct
+        pool = sds(self.kpool.shape, self.kpool.dtype)
+        params = {k: sds(v.shape, v.dtype) for k, v in self._params.items()}
+        key = sds((2,), jnp.uint32)
+        if kind == "prefill":
+            return (pool, pool, params, sds((1, bucket), jnp.int32),
+                    sds((), jnp.int32), sds((self.max_blocks,), jnp.int32),
+                    key, sds((), jnp.float32), sds((), jnp.int32))
+        b = bucket
+        i32 = lambda *s: sds(s, jnp.int32)
+        return (pool, pool, params, i32(b), i32(b, self.max_blocks),
+                i32(b), i32(b), i32(b), sds((b,), jnp.bool_),
+                sds((b, 2), jnp.uint32), sds((b,), jnp.float32), i32(b))
+
+    def _ensure_program(self, kind: str, bucket: int) -> Dict[str, Any]:
+        pkey = (kind, bucket)
+        if pkey in self._programs:
+            return {"source": "ready", "kind": kind, "bucket": bucket}
+        make = (self._make_prefill_fn if kind == "prefill"
+                else self._make_decode_fn)
+        jit_fn = jax.jit(make(bucket), donate_argnums=(0, 1))
+        avals = self._avals(kind, bucket)
+        ckey = cc.program_key(self._fingerprint, avals, donate=(0, 1),
+                              extra={"serve": kind, "bucket": bucket})
+        compiled, info = cc.get_cache().get_or_compile(
+            ckey, lambda: jit_fn.lower(*avals).compile(),
+            label=f"serve.{kind}.{bucket}")
+        self.aot_stats[info["source"]] += 1
+        self._programs[pkey] = _AotProgram(compiled, jit_fn)
+        return dict(info, kind=kind, bucket=bucket)
+
+    def warmup(self) -> List[Dict[str, Any]]:
+        """Resolve every prefill/decode bucket program through the
+        compile cache.  After this, steady-state serving runs zero
+        traces (``trace_counts`` stays flat — pinned by tests)."""
+        with telemetry.span("serve.warmup"):
+            infos = [self._ensure_program("prefill", lb)
+                     for lb in self.prompt_buckets]
+            infos += [self._ensure_program("decode", bb)
+                      for bb in self.decode_buckets]
+        return infos
+
+    # -- submit / stream / cancel -----------------------------------------
+
+    def submit(self, prompt: Sequence[int], *, max_new_tokens: int = 32,
+               temperature: float = 0.0, top_k: int = 0,
+               slo_ms: Optional[float] = None,
+               eos_id: Optional[int] = None,
+               seed: Optional[int] = None) -> int:
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise MXNetError("empty prompt")
+        if len(prompt) > self.prompt_buckets[-1]:
+            raise MXNetError(
+                f"prompt length {len(prompt)} exceeds max_prompt_len "
+                f"bucket {self.prompt_buckets[-1]}")
+        if len(prompt) + max_new_tokens > self.config.max_seq_len:
+            raise MXNetError(
+                f"prompt {len(prompt)} + max_new_tokens {max_new_tokens} "
+                f"exceeds max_seq_len {self.config.max_seq_len}")
+        req = Request(prompt=prompt, max_new_tokens=int(max_new_tokens),
+                      temperature=float(temperature), top_k=int(top_k),
+                      slo_ms=slo_ms, eos_id=eos_id)
+        # the sampling key is (engine seed, request seed, position)-pure:
+        # an explicit `seed` replays the same stream in any engine,
+        # regardless of admission order or batch composition
+        req.key = np.asarray(jax.random.fold_in(
+            self._base_key, req.id if seed is None else int(seed)),
+            np.uint32)
+        self.sched.submit(req)
+        self.requests[req.id] = req
+        telemetry.counter("serve.submitted").inc()
+        return req.id
+
+    def cancel(self, req_id: int) -> None:
+        req = self._req(req_id)
+        if not req.done():
+            self.sched.cancel(req)
+            telemetry.counter("serve.cancelled").inc()
+
+    def request(self, req_id: int) -> Request:
+        return self._req(req_id)
+
+    def _req(self, req_id: int) -> Request:
+        try:
+            return self.requests[req_id]
+        except KeyError:
+            raise MXNetError(f"unknown request id {req_id}")
+
+    def stream(self, req_id: int):
+        """Generator of token ids as they are produced; drives the
+        engine loop while the request is live."""
+        req = self._req(req_id)
+        cursor = 0
+        while True:
+            while cursor < len(req.tokens):
+                yield req.tokens[cursor]
+                cursor += 1
+            if req.done():
+                return
+            self.step()
+
+    def result(self, req_id: int) -> List[int]:
+        """Run the engine until the request completes; returns its
+        generated tokens."""
+        req = self._req(req_id)
+        guard = 0
+        while not req.done():
+            self.step()
+            guard += 1
+            if guard > 10 * self.config.max_seq_len + 100:
+                raise MXNetError(f"request {req_id} failed to converge")
+        return list(req.tokens)
+
+    def run(self, max_steps: int = 100000) -> None:
+        """Drive the loop until every submitted request completes."""
+        for _ in range(max_steps):
+            if self.sched.idle():
+                return
+            self.step()
+        raise MXNetError(f"engine still busy after {max_steps} steps")
+
+    # -- the step loop -----------------------------------------------------
+
+    def step(self) -> None:
+        """One continuous-batching iteration: evict, admit+prefill, one
+        batched decode step.  Any exception dumps the flight recorder
+        (``serve-error``) before propagating."""
+        try:
+            self._step_inner()
+        except Exception as exc:   # noqa: BLE001 — observe, then re-raise
+            telemetry.dump_flight("serve-error", extra={
+                "error": repr(exc), "step": self.step_idx,
+                "active": [r.id for r in self.sched.running],
+                "queued": [r.id for r in self.sched.queue]})
+            raise
+
+    def _step_inner(self) -> None:
+        self.step_idx += 1
+        now = time.monotonic()
+        for req in list(self.sched.running):
+            if req.cancel_requested:
+                self._finish(req, "cancelled", CANCELLED)
+        with telemetry.span("serve.admit", step=self.step_idx,
+                            queued=self.sched.queue_depth):
+            admitted = self.sched.admit(self._can_place, now)
+        for req in admitted:
+            self._prefill(req)
+        if self.sched.running:
+            self._decode_step()
+        telemetry.gauge("serve.queue_depth").set(self.sched.queue_depth)
+        telemetry.gauge("serve.active_slots").set(self.sched.active)
+        telemetry.gauge("serve.kv_blocks_used").set(self.alloc.num_used)
+        telemetry.flight_recorder().record({
+            "kind": "serve", "step": self.step_idx,
+            "active": self.sched.active, "queued": self.sched.queue_depth,
+            "blocks_used": self.alloc.num_used})
+
+    def _can_place(self, req: Request) -> bool:
+        need = self.alloc.blocks_for_tokens(len(req.seed_tokens))
+        return self.alloc.can_alloc(need)
+
+    def _prefill(self, req: Request) -> None:
+        toks = req.seed_tokens
+        plen = len(toks)
+        nblocks = self.alloc.blocks_for_tokens(plen)
+        req.blocks = self.alloc.alloc(nblocks, req.id)
+        lb = cc.bucket_for(plen, self.prompt_buckets)
+        self._ensure_program("prefill", lb)
+        padded = np.zeros((1, lb), np.int32)
+        padded[0, :plen] = toks
+        table_row = np.zeros((self.max_blocks,), np.int32)
+        table_row[:len(req.blocks)] = req.blocks
+        t0 = time.monotonic()
+        with telemetry.span("serve.prefill", req=req.id, bucket=lb,
+                            prompt=plen):
+            self.kpool, self.vpool, tok = self._programs[("prefill", lb)](
+                self.kpool, self.vpool, self._params, padded,
+                np.int32(plen), table_row, req.key,
+                np.float32(req.temperature), np.int32(req.top_k))
+        req.cached = plen
+        telemetry.counter("serve.prefills").inc()
+        telemetry.histogram("serve.prefill_ms").observe(
+            (time.monotonic() - t0) * 1e3)
+        self._append_token(req, int(tok))
+
+    def _grow_blocks(self, req: Request) -> bool:
+        """Ensure the request owns a block for cache index ``cached``.
+        On pool exhaustion, preempts the youngest-admitted request
+        (recompute-style: blocks freed, request requeued; its sampling
+        replays identically).  Returns False if ``req`` itself was
+        preempted."""
+        while len(req.blocks) * self.alloc.block_size < req.cached + 1:
+            if self.alloc.can_alloc(1):
+                req.blocks += self.alloc.alloc(1, req.id)
+                continue
+            victim = max(self.sched.running,
+                         key=lambda r: (r.admit_t or 0.0, r.id))
+            self._preempt(victim)
+            if victim is req:
+                return False
+        return True
+
+    def _preempt(self, victim: Request) -> None:
+        telemetry.counter("serve.preemptions").inc()
+        self.alloc.free(victim.blocks)
+        victim.blocks = []
+        victim.cached = 0
+        self.sched.requeue(victim)
+
+    def _decode_step(self) -> None:
+        # growth pass first: a preemption inside _grow_blocks mutates
+        # sched.running, so the batch roster is only read afterwards
+        # (a preempted victim must not decode on freed blocks)
+        for req in list(self.sched.running):
+            if req in self.sched.running:
+                self._grow_blocks(req)
+        active = list(self.sched.running)
+        if not active:
+            return
+        bb = cc.bucket_for(len(active), self.decode_buckets)
+        self._ensure_program("decode", bb)
+        bsz = self.alloc.block_size
+        tokens = np.zeros((bb,), np.int32)
+        tables = np.zeros((bb, self.max_blocks), np.int32)
+        lengths = np.zeros((bb,), np.int32)
+        slots = np.zeros((bb,), np.int32)
+        offsets = np.zeros((bb,), np.int32)
+        active_m = np.zeros((bb,), np.bool_)
+        keys = np.zeros((bb, 2), np.uint32)
+        temps = np.zeros((bb,), np.float32)
+        topks = np.zeros((bb,), np.int32)
+        for i, req in enumerate(active):
+            tokens[i] = req.tokens[-1]
+            tables[i, :len(req.blocks)] = req.blocks
+            lengths[i] = req.cached
+            slots[i] = req.blocks[req.cached // bsz]
+            offsets[i] = req.cached % bsz
+            active_m[i] = True
+            keys[i] = req.key
+            temps[i] = req.temperature
+            topks[i] = req.top_k
+        t0 = time.monotonic()
+        with telemetry.span("serve.decode", step=self.step_idx, bucket=bb,
+                            active=len(active)):
+            self.kpool, self.vpool, toks = self._programs[("decode", bb)](
+                self.kpool, self.vpool, self._params, tokens, tables,
+                lengths, slots, offsets, active_m, keys, temps, topks)
+        toks = np.asarray(toks)
+        step_ms = (time.monotonic() - t0) * 1e3
+        hist = telemetry.histogram("serve.token_ms")
+        for i, req in enumerate(active):
+            req.cached += 1
+            hist.observe(step_ms)
+            self._append_token(req, int(toks[i]))
+
+    def _append_token(self, req: Request, tok: int) -> None:
+        now = time.monotonic()
+        req.tokens.append(tok)
+        req.token_times.append(now)
+        if req.first_token_t is None:
+            req.first_token_t = now
+            telemetry.histogram("serve.ttft_ms").observe(
+                (now - req.submit_t) * 1e3)
+        telemetry.counter("serve.tokens_total").inc()
+        if req.eos_id is not None and tok == req.eos_id:
+            self._finish(req, "eos")
+        elif len(req.tokens) >= req.max_new_tokens:
+            self._finish(req, "length")
+
+    def _finish(self, req: Request, reason: str,
+                state: str = FINISHED) -> None:
+        self.sched.finish(req, reason, state)
+        if req.blocks:
+            self.alloc.free(req.blocks)
+            req.blocks = []
+        telemetry.counter("serve.evictions").inc(reason=reason)
+
+    # -- maintenance / introspection ---------------------------------------
+
+    def defrag(self) -> int:
+        """Compact live KV blocks to the low end of the pool (both
+        pools move in lockstep, tables are rewritten).  Returns the
+        number of relocated blocks; outputs are bitwise unaffected."""
+        mapping = self.alloc.defrag()
+        if mapping:
+            self.kpool = kvcache.compact_pool(self.kpool, mapping)
+            self.vpool = kvcache.compact_pool(self.vpool, mapping)
+            for req in self.sched.running:
+                req.blocks = [mapping.get(b, b) for b in req.blocks]
+        return len(mapping)
+
+    def check_tables(self) -> None:
+        """Allocator/table integrity audit (raises on any violation)."""
+        self.alloc.check({r.id: r.blocks for r in self.sched.running
+                          if r.blocks})
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "aot": dict(self.aot_stats),
+            "traces": dict(self.trace_counts),
+            "blocks_used": self.alloc.num_used,
+            "blocks_free": self.alloc.num_free,
+            "active": self.sched.active,
+            "queued": self.sched.queue_depth,
+            "steps": self.step_idx,
+            "prompt_buckets": list(self.prompt_buckets),
+            "decode_buckets": list(self.decode_buckets),
+        }

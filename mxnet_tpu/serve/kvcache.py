@@ -1,0 +1,293 @@
+"""Paged/blocked KV-cache for autoregressive serving (docs/serving.md).
+
+vLLM-style paging on top of the repo's blockwise-attention machinery:
+key/value states live in **preallocated device pools** of fixed-size
+blocks (``[num_layers, num_blocks, block_size, heads, head_dim]``), and
+each in-flight request owns a host-side **block table** — logical block
+``j`` of the request maps to physical pool slot ``table[j]``.  Slots are
+recycled the moment a request finishes, so HBM for the cache is bounded
+by the pool, not by max-batch × max-seq-len.
+
+The device side is three pure functions, all shape-static so the serve
+engine's decode program never retraces:
+
+* :func:`paged_attention` — one query token per request attends over its
+  table-addressed blocks with the same online-softmax block scan as
+  ``parallel/ring_attention.blockwise_attention`` / the flash kernels
+  (running max / sum / accumulator in f32, ``NEG_INF`` masking).  Blocks
+  are gathered straight out of the pool per scan step; the padded dense
+  [B, L_max] score matrix is never materialized.
+* :func:`write_prefill` / :func:`write_decode` — functional scatters of
+  freshly-computed K/V states into table-addressed slots.  Padded or
+  inactive rows are redirected to the reserved **trash block 0** so the
+  scatter itself stays branch-free.
+
+The host side is :class:`BlockAllocator`: a free-list allocator with
+alloc/free/defrag and per-request ownership tracking (table integrity is
+checkable at any time via :meth:`BlockAllocator.check`).
+
+Bitwise note (docs/perf.md r7 applies): :func:`dense_attention` runs the
+*same* block scan over a contiguous cache, so paged-vs-dense parity is
+exact — the paging indirection is a pure gather of identical values at
+identical shapes.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import MXNetError
+from ..parallel.flash_attention import NEG_INF
+
+__all__ = ["TRASH_BLOCK", "BlockAllocator", "make_pools",
+           "paged_attention", "dense_attention", "write_prefill",
+           "write_decode", "compact_pool"]
+
+#: physical slot 0 is never handed out: padded prefill positions and
+#: inactive decode rows scatter their garbage there, keeping every
+#: device-side write unconditional (no retrace-prone masking branches).
+TRASH_BLOCK = 0
+
+
+# ---------------------------------------------------------------------------
+# Host side: block allocator
+# ---------------------------------------------------------------------------
+
+class BlockAllocator:
+    """Free-list allocator over the physical slots of a KV pool.
+
+    Slot ``TRASH_BLOCK`` (0) is reserved.  ``alloc`` hands out the
+    lowest free slots (deterministic — replays identically), ``free``
+    returns a request's slots, ``defrag`` compacts live slots toward the
+    low end of the pool and returns the relocation map the engine
+    applies with :func:`compact_pool`.
+    """
+
+    def __init__(self, num_blocks: int, block_size: int):
+        if num_blocks < 2:
+            raise MXNetError("BlockAllocator needs >= 2 blocks "
+                             "(slot 0 is the reserved trash block)")
+        if block_size < 1:
+            raise MXNetError(f"block_size must be >= 1, got {block_size}")
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        self._free: List[int] = list(range(1, num_blocks))
+        self._owner: Dict[int, object] = {}   # phys slot -> request id
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def num_used(self) -> int:
+        return len(self._owner)
+
+    def blocks_for_tokens(self, ntokens: int) -> int:
+        """Blocks needed to hold ``ntokens`` cache entries."""
+        return max(1, -(-int(ntokens) // self.block_size))
+
+    def can_alloc(self, nblocks: int) -> bool:
+        return nblocks <= len(self._free)
+
+    def alloc(self, nblocks: int, owner) -> List[int]:
+        if nblocks > len(self._free):
+            raise MXNetError(
+                f"kv pool exhausted: want {nblocks} blocks, "
+                f"{len(self._free)} free of {self.num_blocks - 1}")
+        self._free.sort()
+        got, self._free = self._free[:nblocks], self._free[nblocks:]
+        for b in got:
+            self._owner[b] = owner
+        return got
+
+    def free(self, blocks: Sequence[int]) -> None:
+        for b in blocks:
+            if b not in self._owner:
+                raise MXNetError(f"double free of kv block {b}")
+            del self._owner[b]
+            self._free.append(b)
+
+    def owned_by(self, owner) -> List[int]:
+        return sorted(b for b, o in self._owner.items() if o == owner)
+
+    def check(self, tables: Dict[object, Sequence[int]]) -> None:
+        """Table-integrity audit: every table entry is a live slot owned
+        by that request, no slot appears in two tables, and the free
+        list is disjoint from every table."""
+        seen: Dict[int, object] = {}
+        free = set(self._free)
+        for owner, table in tables.items():
+            for b in table:
+                if b == TRASH_BLOCK:
+                    raise MXNetError(f"{owner!r}: table points at the "
+                                     "trash block")
+                if self._owner.get(b) != owner:
+                    raise MXNetError(f"{owner!r}: block {b} not owned "
+                                     f"(owner={self._owner.get(b)!r})")
+                if b in seen:
+                    raise MXNetError(f"block {b} shared by {seen[b]!r} "
+                                     f"and {owner!r}")
+                if b in free:
+                    raise MXNetError(f"block {b} both free and mapped")
+                seen[b] = owner
+        extra = set(self._owner) - set(seen)
+        if extra:
+            raise MXNetError(f"leaked blocks (owned, not in any table): "
+                             f"{sorted(extra)}")
+
+    def defrag(self) -> Dict[int, int]:
+        """Compact live slots to the lowest physical indices.  Returns
+        ``{old_slot: new_slot}`` for every *moved* slot; the caller must
+        rewrite its tables and apply :func:`compact_pool` with the same
+        map before the next device step."""
+        live = sorted(self._owner)
+        mapping: Dict[int, int] = {}
+        target = 1
+        for b in live:
+            if b != target:
+                mapping[b] = target
+            target += 1
+        if mapping:
+            self._owner = {mapping.get(b, b): o
+                           for b, o in self._owner.items()}
+            nlive = len(live)
+            self._free = list(range(1 + nlive, self.num_blocks))
+        return mapping
+
+
+# ---------------------------------------------------------------------------
+# Device side: pools + paged reads/writes
+# ---------------------------------------------------------------------------
+
+def make_pools(num_layers: int, num_blocks: int, block_size: int,
+               heads: int, head_dim: int,
+               dtype=jnp.float32) -> Tuple[jax.Array, jax.Array]:
+    """Preallocate the K and V pools:
+    ``[num_layers, num_blocks, block_size, heads, head_dim]``."""
+    shape = (num_layers, num_blocks, block_size, heads, head_dim)
+    return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+
+
+def _attend_blocks(q, read_block, nblk: int, block_size: int, lengths,
+                   scale):
+    """Shared online-softmax block scan (one query token per row).
+
+    ``q``: [B, H, hd]; ``read_block(j)`` -> ([B, BS, H, hd] K,
+    [B, BS, H, hd] V) for logical block ``j``; ``lengths``: [B] valid
+    cache entries per row.  Same running (max, sum, acc) statistics as
+    ``blockwise_attention`` — f32 stats, ``NEG_INF`` masking — but the
+    mask is a length mask, not a causal one: the single query sits at
+    position ``lengths-1`` and may see every valid entry.
+    """
+    f32 = jnp.float32
+    b, h, d = q.shape
+    m = jnp.full((b, h), NEG_INF, f32)
+    l = jnp.zeros((b, h), f32)
+    acc = jnp.zeros((b, h, d), f32)
+    offs = jnp.arange(block_size)
+    for j in range(nblk):
+        k_blk, v_blk = read_block(j)
+        s = jnp.einsum("bhd,bkhd->bhk", q, k_blk).astype(f32) * scale
+        valid = (j * block_size + offs)[None, :] < lengths[:, None]
+        s = jnp.where(valid[:, None, :], s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[..., None])
+        p = jnp.where(valid[:, None, :], p, 0.0)
+        l = l * alpha + jnp.sum(p, axis=-1)
+        acc = (acc * alpha[..., None]
+               + jnp.einsum("bhk,bkhd->bhd", p, v_blk.astype(f32)))
+        m = m_new
+    l = jnp.maximum(l, 1e-30)
+    return (acc / l[..., None]).astype(q.dtype)
+
+
+def paged_attention(q, k_pool, v_pool, tables, lengths, *,
+                    scale: Optional[float] = None):
+    """One-token-per-request attention over a paged cache.
+
+    ``q``: [B, H, hd] query states; ``k_pool``/``v_pool``:
+    [num_blocks, BS, H, hd] (one layer's pool); ``tables``:
+    [B, max_blocks] int32 physical slot per logical block (unused
+    entries may hold any valid slot — the length mask kills them);
+    ``lengths``: [B] int32 valid cache entries (including the current
+    token, which must already be written).  Returns [B, H, hd].
+    """
+    b, h, d = q.shape
+    nblk = tables.shape[1]
+    bs = k_pool.shape[1]
+    scale_ = (1.0 / np.sqrt(d)) if scale is None else scale
+
+    def read_block(j):
+        slot = tables[:, j]
+        return jnp.take(k_pool, slot, axis=0), jnp.take(v_pool, slot, axis=0)
+
+    return _attend_blocks(q, read_block, nblk, bs, lengths, scale_)
+
+
+def dense_attention(q, k_buf, v_buf, lengths, *, block_size: int,
+                    scale: Optional[float] = None):
+    """The dense (non-paged) counterpart: same block scan, but K/V come
+    from contiguous per-request buffers ``[B, L_pad, H, hd]``
+    (``L_pad`` a multiple of ``block_size``).  Used by the parity tests:
+    paged vs dense must agree bitwise because the only difference is a
+    gather of identical values at identical shapes."""
+    b, lpad, h, d = k_buf.shape
+    if lpad % block_size:
+        raise MXNetError(f"dense cache length {lpad} not a multiple of "
+                         f"block {block_size}")
+    nblk = lpad // block_size
+    scale_ = (1.0 / np.sqrt(d)) if scale is None else scale
+    kb = k_buf.reshape(b, nblk, block_size, h, d)
+    vb = v_buf.reshape(b, nblk, block_size, h, d)
+
+    def read_block(j):
+        return kb[:, j], vb[:, j]
+
+    return _attend_blocks(q, read_block, nblk, block_size, lengths, scale_)
+
+
+def write_prefill(pool, layer: int, states, table_row, length):
+    """Scatter a prompt's K or V states into its table's slots.
+
+    ``pool``: [layers, nblocks, BS, H, hd]; ``states``: [L_pad, H, hd]
+    (bucket-padded); ``table_row``: [max_blocks] int32; ``length``:
+    scalar valid positions.  Positions ``>= length`` land in the trash
+    block.  Returns the updated pool (functional; donate the input).
+    """
+    lpad = states.shape[0]
+    bs = pool.shape[2]
+    pos = jnp.arange(lpad)
+    logical = pos // bs
+    # bucket L_pad may exceed table capacity * BS for short prompts;
+    # clamp the logical index — those positions are >= length anyway.
+    logical = jnp.minimum(logical, table_row.shape[0] - 1)
+    slot = jnp.where(pos < length, jnp.take(table_row, logical),
+                     TRASH_BLOCK)
+    return pool.at[layer, slot, pos % bs].set(states)
+
+
+def write_decode(pool, layer: int, states, slots, offsets, active):
+    """Scatter one decode step's K or V states, one position per row.
+
+    ``states``: [B, H, hd]; ``slots``: [B] physical block per row;
+    ``offsets``: [B] position within the block; ``active``: [B] bool —
+    inactive rows write to the trash block.  Returns the updated pool.
+    """
+    slot = jnp.where(active, slots, TRASH_BLOCK)
+    return pool.at[layer, slot, offsets].set(states)
+
+
+def compact_pool(pool, mapping: Dict[int, int]):
+    """Apply a :meth:`BlockAllocator.defrag` relocation map to a pool:
+    copy each moved slot's contents to its new physical index.  Values
+    are moved, never transformed, so post-defrag attention output is
+    bitwise identical (gather of the same values)."""
+    if not mapping:
+        return pool
+    src = jnp.asarray(sorted(mapping), jnp.int32)
+    dst = jnp.asarray([mapping[int(s)] for s in sorted(mapping)], jnp.int32)
+    return pool.at[:, dst].set(pool[:, src])

@@ -278,6 +278,33 @@ def test_ef_defaults_and_validation():
                        error_feedback=True)
 
 
+def test_ef_grad_accum_falls_back_off(caplog):
+    """EF + grad_accum>1 composes wrong (the residual has no home
+    inside the microbatch scan): the trainer must NOT silently run it —
+    it warns, disables EF, and trains correctly without it (the r9
+    follow-up pinned by issue 10)."""
+    import logging as _logging
+    mx.random.seed(9)
+    with caplog.at_level(_logging.WARNING, "mxnet_tpu.parallel.trainer"):
+        tr = ShardedTrainer(_mlp(), optimizer="sgd",
+                            optimizer_params={"learning_rate": 0.05},
+                            mesh=make_mesh({"data": -1}),
+                            grad_compression="int8",
+                            error_feedback=True, grad_accum=2)
+    assert tr.error_feedback is False
+    assert any("error_feedback" in r.message and "grad_accum" in r.message
+               for r in caplog.records)
+    tr.bind({"data": (32, 8)}, {"softmax_label": (32,)})
+    # no residual state materializes, and a step runs clean
+    assert not any(k.startswith("efres:") for k in tr._opt_state)
+    tr.step(_toy_batches(1)[0])
+    # the default path (error_feedback=None) stays silently off too
+    tr2 = ShardedTrainer(_mlp(), optimizer="sgd",
+                         mesh=make_mesh({"data": -1}),
+                         grad_compression="int8", grad_accum=2)
+    assert tr2.error_feedback is False
+
+
 def test_efres_state_shape_and_sharding():
     tr = _ef_trainer("int8")
     keys = [k for k in tr._opt_state if k.startswith("efres:")]

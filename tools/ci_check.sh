@@ -45,6 +45,19 @@ if [[ "$mode" != "--tests-only" ]]; then
     fi
 fi
 
+if [[ "$mode" != "--tests-only" ]]; then
+    # end-to-end check of the serving tier: 8 concurrent streams
+    # through the paged-KV continuous-batching engine, decode warm
+    # after step 1, serve spans in a valid trace (docs/serving.md)
+    echo "== serve smoke (tools/serve_smoke.py) =="
+    python tools/serve_smoke.py
+    rc=$?
+    if [[ $rc -ne 0 ]]; then
+        echo "ci_check: serve smoke FAILED (rc=$rc)" >&2
+        exit "$rc"
+    fi
+fi
+
 if [[ "$mode" == "--gate-only" ]]; then
     exit 0
 fi

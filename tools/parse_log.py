@@ -22,6 +22,11 @@ every audited config present in both, the per-bucket HBM pass counts
 regression-triage view for grad-bucket memory-traffic changes
 (docs/static_analysis.md).
 
+``--diff-serve A B`` diffs two ``bench.py --serve`` reports
+(BENCH_r10.json-style): tokens/s and p99 per-token latency per serving
+config — exits 1 when tokens/s regresses beyond the noise floor or p99
+grows more than 10% (docs/serving.md).
+
 ``--diff-metrics A.jsonl B.jsonl`` diffs two telemetry metric streams
 (``MXNET_TPU_METRICS_FILE``): the final registry snapshots' headline
 series (mean step time from the ``step.host_ms`` histogram, guard /
@@ -246,6 +251,83 @@ def diff_audits(path_a, path_b):
     return 0
 
 
+def read_serve(path):
+    """{metric: row} for the serving rows of a ``bench.py --serve``
+    report (BENCH_r10.json-style JSON array, or one JSON object per
+    line).  Serve rows carry tokens/s plus per-token latency
+    percentiles (``p99_token_ms``) or the headline speedup ratio."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        recs = json.loads(text)
+        if isinstance(recs, dict):
+            recs = [recs]
+    except ValueError:
+        recs = []
+        for line in text.splitlines():
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                recs.append(json.loads(line))
+            except ValueError:
+                continue
+    return {rec["metric"]: rec for rec in recs
+            if isinstance(rec, dict)
+            and str(rec.get("metric", "")).startswith("serve ")}
+
+
+# tokens/s gets a small noise floor (a shared CPU host wobbles a few
+# percent run to run); the p99 latency bar is the ISSUE 10 contract
+SERVE_TOKENS_TOL = 0.05   # B may be up to 5% below A before failing
+SERVE_P99_GROWTH = 0.10   # p99 per-token latency may grow up to 10%
+
+
+def diff_serve(path_a, path_b):
+    """Per-config serving comparison of two ``bench.py --serve``
+    reports (B relative to A): tokens/s must not regress (beyond the
+    5% noise floor) and p99 per-token latency must not grow more than
+    10% — the triage gate for serving-path changes."""
+    a, b = read_serve(path_a), read_serve(path_b)
+    common = [m for m in a if m in b]
+    if not common:
+        print("no common serve rows between the two reports",
+              file=sys.stderr)
+        return 1
+    worse = []
+    print("| config | tok/s A | tok/s B | Δ% | p99 A | p99 B | Δ% |")
+    print("|---|---|---|---|---|---|---|")
+    for metric in common:
+        ra, rb = a[metric], b[metric]
+        cells = []
+        ta = ra.get("value") if ra.get("unit") == "tokens/s" else None
+        tb = rb.get("value") if rb.get("unit") == "tokens/s" else None
+        for va, vb, shrink_ok, bar, what in (
+                (ta, tb, False, SERVE_TOKENS_TOL, "tokens/s"),
+                (ra.get("p99_token_ms"), rb.get("p99_token_ms"),
+                 True, SERVE_P99_GROWTH, "p99_token_ms")):
+            cells.append("" if va is None else f"{va:g}")
+            cells.append("" if vb is None else f"{vb:g}")
+            if va and vb is not None:
+                pct = (vb - va) / va
+                cells.append(f"{100 * pct:+.1f}%")
+                if shrink_ok and pct > bar:
+                    worse.append(f"{metric}: {what} grew {100 * pct:.1f}%"
+                                 f" (> {100 * bar:.0f}%)")
+                elif not shrink_ok and pct < -bar:
+                    worse.append(f"{metric}: {what} fell {-100 * pct:.1f}%"
+                                 f" (> {100 * bar:.0f}% floor)")
+            else:
+                cells.append("")
+        print(f"| {metric} | " + " | ".join(cells) + " |")
+    only = [m for m in (set(a) | set(b)) if m not in common]
+    if only:
+        print(f"\n(unmatched configs: {sorted(only)})", file=sys.stderr)
+    for msg in worse:
+        print(f"REGRESSED: {msg}", file=sys.stderr)
+    return 1 if worse else 0
+
+
 def read_metrics_stream(path):
     """Parse a telemetry JSONL stream (``MXNET_TPU_METRICS_FILE``):
     returns ``(final_snapshot, step_rows, resil_rows)``.  The LAST
@@ -371,7 +453,14 @@ def main():
                     "(MXNET_TPU_METRICS_FILE): headline metric series "
                     "(step time, guard, wire bytes, cache hits), plus "
                     "audit and resilience rows, B relative to A")
+    ap.add_argument("--diff-serve", nargs=2, metavar=("A", "B"),
+                    help="diff two bench.py --serve reports "
+                    "(BENCH_r10.json): exits 1 if tokens/s regressed "
+                    "beyond the 5%% noise floor or p99 per-token "
+                    "latency grew more than 10%%, B relative to A")
     args = ap.parse_args()
+    if args.diff_serve:
+        return diff_serve(*args.diff_serve)
     if args.diff_profile:
         return diff_profiles(*args.diff_profile)
     if args.diff_resilience:
