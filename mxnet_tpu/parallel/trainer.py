@@ -245,7 +245,7 @@ class ShardedTrainer:
                 # carrying it across microbatches computes the WRONG
                 # correction.  Serve the combination safely: warn and
                 # fall back to EF-off instead of poisoning the run
-                # (pinned by tests/test_quant_collectives.py).
+                # (pinned by tests/test_quant.py).
                 logging.getLogger(__name__).warning(
                     "error_feedback=True does not compose with "
                     "grad_accum=%d (reduction runs inside the "

@@ -183,10 +183,17 @@ class Engine:
             self.head_dim, dtype=config.dtype)
         self.sched = Scheduler(config.max_batch, config.max_queue,
                                config.slo_ms, config.slo_admit_frac)
+        if config.max_prompt_len > config.max_seq_len:
+            raise MXNetError(
+                f"max_prompt_len {config.max_prompt_len} exceeds "
+                f"max_seq_len {config.max_seq_len}")
         policy = cc.BucketPolicy(min_bucket=config.prompt_bucket_min,
                                  factor=config.prompt_bucket_factor,
                                  round_to=config.prompt_bucket_min)
-        self.prompt_buckets = tuple(policy._ladder(config.max_prompt_len))
+        # the ladder covers max_seq_len, not max_prompt_len: a preempted
+        # request re-prefills with prompt + already-generated tokens,
+        # which may exceed any fresh prompt's length
+        self.prompt_buckets = tuple(policy._ladder(config.max_seq_len))
         self.decode_buckets = config.resolved_decode_buckets()
         self._base_key = jax.random.PRNGKey(config.seed)
         self._programs: Dict[Tuple[str, int], _AotProgram] = {}
@@ -309,10 +316,10 @@ class Engine:
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise MXNetError("empty prompt")
-        if len(prompt) > self.prompt_buckets[-1]:
+        if len(prompt) > self.config.max_prompt_len:
             raise MXNetError(
                 f"prompt length {len(prompt)} exceeds max_prompt_len "
-                f"bucket {self.prompt_buckets[-1]}")
+                f"{self.config.max_prompt_len}")
         if len(prompt) + max_new_tokens > self.config.max_seq_len:
             raise MXNetError(
                 f"prompt {len(prompt)} + max_new_tokens {max_new_tokens} "
@@ -402,7 +409,7 @@ class Engine:
                 self._finish(req, "cancelled", CANCELLED)
         with telemetry.span("serve.admit", step=self.step_idx,
                             queued=self.sched.queue_depth):
-            admitted = self.sched.admit(self._can_place, now)
+            admitted = self.sched.admit(self._admission_gate(), now)
         for req in admitted:
             self._prefill(req)
         if self.sched.running:
@@ -415,9 +422,23 @@ class Engine:
             "active": self.sched.active, "queued": self.sched.queue_depth,
             "blocks_used": self.alloc.num_used})
 
-    def _can_place(self, req: Request) -> bool:
-        need = self.alloc.blocks_for_tokens(len(req.seed_tokens))
-        return self.alloc.can_alloc(need)
+    def _admission_gate(self):
+        """``can_place`` for one admit pass.  Blocks promised to earlier
+        accepted candidates are reserved against the free count, so two
+        requests admitted in the same pass can never jointly claim more
+        blocks than the pool has (their ``_prefill`` allocs all
+        succeed)."""
+        reserved = 0
+
+        def can_place(req: Request) -> bool:
+            nonlocal reserved
+            need = self.alloc.blocks_for_tokens(len(req.seed_tokens))
+            if reserved + need > self.alloc.num_free:
+                return False
+            reserved += need
+            return True
+
+        return can_place
 
     def _prefill(self, req: Request) -> None:
         toks = req.seed_tokens

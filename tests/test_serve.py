@@ -339,6 +339,42 @@ def test_preemption_token_parity():
     assert eng.alloc.num_used == 0
 
 
+def test_admit_pass_never_overcommits_pool():
+    """Two requests accepted in the same admit pass must not jointly
+    claim more KV blocks than are free: the admission gate reserves
+    tentatively, so the second stays QUEUED instead of crashing
+    ``step()`` with 'kv pool exhausted' mid-prefill."""
+    # 3 usable blocks of 4; each prompt needs 2 blocks at prefill
+    eng = _engine(num_blocks=4, max_batch=4)
+    a = eng.submit([1, 2, 3, 4, 5], max_new_tokens=3)
+    b = eng.submit([6, 7, 8, 9, 10], max_new_tokens=3)
+    eng.step()                                   # must not raise
+    assert eng.requests[a].state == ACTIVE
+    assert eng.requests[b].state == QUEUED       # deferred, not crashed
+    eng.run()
+    assert eng.requests[a].state == FINISHED
+    assert eng.requests[b].state == FINISHED
+    assert eng.alloc.num_used == 0
+
+
+def test_reprefill_after_preemption_has_bucket():
+    """A preempted request re-prefills with prompt + generated tokens,
+    which can exceed ``max_prompt_len``; the prefill ladder is built to
+    ``max_seq_len`` so the re-admission still finds a bucket — and the
+    replayed stream is exact."""
+    ref_eng = _engine()
+    ref = ref_eng.result(
+        ref_eng.submit(list(range(1, 17)), max_new_tokens=12))
+    eng = _engine()
+    rid = eng.submit(list(range(1, 17)), max_new_tokens=12)
+    for _ in range(6):
+        eng.step()
+    req = eng.requests[rid]
+    assert len(req.seed_tokens) > eng.config.max_prompt_len
+    eng._preempt(req)                            # force recompute-restart
+    assert eng.result(rid) == ref
+
+
 # ---------------------------------------------------------------------------
 # Zero traces after warmup; warm restart
 # ---------------------------------------------------------------------------
