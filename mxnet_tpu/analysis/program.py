@@ -33,6 +33,15 @@ and charges THAT element width.  An fp8/int8 bucket is therefore ¼ the
 bytes of its f32 twin in the metric, and auditing with
 ``expect_wire_itemsize`` turns silent re-widening (a refactor dropping
 the quantize) into a finding.
+
+The same rule covers serving **decode programs** (round 12): a paged
+KV-cache read is a ``gather`` whose operand is pool-shaped (rank >= 4 —
+``[blocks, block_size, heads, head_dim]`` or the full per-layer pool),
+and its element width is the KV bytes-per-token the decode step streams.
+An fp8 pool reads 1-byte payloads (the f32 per-block scales are rank-2/3
+gathers, excluded by shape); auditing with ``expect_kv_itemsize=1``
+turns a silently re-widened pool (a refactor reading a pre-dequantized
+f32 copy) into the same ``program.hbm-bytes`` finding.
 """
 
 from __future__ import annotations
@@ -61,7 +70,7 @@ __all__ = [
     "AuditConfig", "tag", "mark_grads", "audit_traced", "audit_trainer",
     "audit_executor", "audit_module", "audit_optimizer",
     "audit_on_compile", "assert_program_clean", "update_passes",
-    "collective_wire_rows",
+    "collective_wire_rows", "kv_read_rows",
 ]
 
 
@@ -704,6 +713,74 @@ def _check_hbm_bytes(rows: List[Dict[str, Any]], expect_itemsize: int,
                          "expect_wire_itemsize": expect_itemsize}))
 
 
+def kv_read_rows(closed, config: Optional[AuditConfig] = None
+                 ) -> List[Dict[str, Any]]:
+    """One row per paged KV-pool read: ``{shape, dtype, itemsize, elems,
+    bytes, f32_bytes}``.
+
+    A pool read is a ``gather`` whose operand is pool-shaped — rank >= 4
+    (``[blocks, block_size, heads, head_dim]`` layer view, or the full
+    ``[layers, ...]`` pool).  That shape filter keeps embedding lookups
+    (rank 2) and the fp8 per-block scale gathers (rank 2/3) out, so the
+    rows measure exactly the K/V payload traffic a decode step streams;
+    ``bytes`` charges the operand's element width over the gathered
+    output elements, ``f32_bytes`` is the unquantized twin (elems x 4)
+    for ratio math."""
+    rows: List[Dict[str, Any]] = []
+    for level in _all_jaxpr_levels(closed):
+        for eqn in level.jaxpr.eqns:
+            if eqn.primitive.name != "gather":
+                continue
+            src = eqn.invars[0]
+            if isinstance(src, _jex_core.Literal):
+                continue
+            aval = getattr(src, "aval", None)
+            dt = getattr(aval, "dtype", None)
+            if dt is None or len(aval.shape) < 4:
+                continue
+            out = eqn.outvars[0].aval
+            elems = int(np.prod(out.shape, dtype=np.int64))
+            rows.append({
+                "shape": list(aval.shape),
+                "dtype": str(dt),
+                "itemsize": int(dt.itemsize),
+                "elems": elems,
+                "bytes": elems * int(dt.itemsize),
+                "f32_bytes": elems * 4,
+            })
+    return rows
+
+
+def _check_kv_bytes(rows: List[Dict[str, Any]], expect_itemsize: int,
+                    program: str, report: Report) -> None:
+    """The ``program.hbm-bytes`` rule over paged KV-cache reads: with
+    ``expect_kv_itemsize`` set (the engine runs a quantized pool), every
+    pool-shaped gather must read elements at most that wide — a wider
+    read means the program streams a silently re-widened pool and the
+    decode step's HBM bytes/token snapped back to full precision."""
+    if not rows:
+        report.add(Finding(
+            "program.hbm-bytes",
+            "expect_kv_itemsize was set but the program has no "
+            "pool-shaped KV gather — the paged cache read is not in "
+            "the trace",
+            program=program,
+            details={"expect_kv_itemsize": expect_itemsize}))
+        return
+    for r in rows:
+        if r["itemsize"] > expect_itemsize:
+            report.add(Finding(
+                "program.hbm-bytes",
+                f"paged KV gather over {r['dtype']}{r['shape']} reads "
+                f"{r['itemsize']} bytes/elem — expected <= "
+                f"{expect_itemsize} (quantized pool); the cache "
+                "silently widened back to full precision",
+                program=program,
+                details={**{k: r[k] for k in
+                            ("dtype", "itemsize", "bytes", "f32_bytes")},
+                         "expect_kv_itemsize": expect_itemsize}))
+
+
 # ----------------------------------------------------------------------
 # Generic entry: audit one traced program
 # ----------------------------------------------------------------------
@@ -715,6 +792,7 @@ def audit_traced(traced, program: str,
                  replicated_out: Optional[Sequence[Tuple[int, str]]] = None,
                  expect_fused: bool = False,
                  expect_wire_itemsize: Optional[int] = None,
+                 expect_kv_itemsize: Optional[int] = None,
                  config: Optional[AuditConfig] = None,
                  report: Optional[Report] = None) -> Report:
     """Run every program rule over one ``jax.stages.Traced``.
@@ -732,6 +810,10 @@ def audit_traced(traced, program: str,
     every bucket-scale floating reduce collective must put at most this
     many bytes/elem on the wire (``program.hbm-bytes`` findings
     otherwise; the wire-bytes rows land in the metrics either way).
+    ``expect_kv_itemsize``: assert the quantized paged-KV contract —
+    every pool-shaped gather must read elements at most this wide
+    (``program.hbm-bytes`` findings otherwise; the kv-read rows land in
+    the metrics either way).
     """
     config = config or AuditConfig()
     report = report if report is not None else Report(mode="audit")
@@ -785,6 +867,15 @@ def audit_traced(traced, program: str,
         if expect_wire_itemsize is not None:
             _check_hbm_bytes(rows, expect_wire_itemsize, program,
                              report, config)
+        krows = kv_read_rows(closed, config)
+        if krows:
+            metrics["kv_reads"] = {
+                "reads": krows,
+                "read_bytes": sum(r["bytes"] for r in krows),
+                "f32_bytes": sum(r["f32_bytes"] for r in krows),
+            }
+        if expect_kv_itemsize is not None:
+            _check_kv_bytes(krows, expect_kv_itemsize, program, report)
     report.metrics[program] = metrics
     profiler.record_audit(program, len(report.findings) - n0,
                           time.perf_counter() - t0)

@@ -543,6 +543,17 @@ class BucketPolicy:
         self.buckets = sorted(int(b) for b in buckets) if buckets else None
 
     @classmethod
+    def fixed(cls, size: int) -> "BucketPolicy":
+        """A single-rung policy: every length pads to ``size`` (longer
+        lengths raise).  The chunked-prefill serve path uses this to
+        collapse the geometric prompt ladder to one chunk shape — one
+        warm program instead of one per rung."""
+        if size < 1:
+            raise MXNetError(f"BucketPolicy.fixed: size must be >= 1, "
+                             f"got {size}")
+        return cls(min_bucket=int(size), round_to=1, buckets=[int(size)])
+
+    @classmethod
     def from_env(cls, **kwargs) -> "BucketPolicy":
         """Build from ``MXNET_TPU_BUCKET_POLICY=min:factor:round`` (+
         ``MXNET_TPU_MAX_BUCKETS``); explicit kwargs win."""

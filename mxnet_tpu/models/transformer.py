@@ -239,6 +239,41 @@ def transformer_lm_prefill(params, tokens, *, heads):
     return _lm_head(params, h), ks, vs
 
 
+def transformer_lm_prefill_chunk(params, tokens, *, heads, attend):
+    """One **chunk** of a prompt's prefill over a caller-owned KV cache.
+
+    The chunked twin of :func:`transformer_lm_prefill`: ``tokens`` is a
+    [B, C] slice of the prompt (C = the serve tier's chunk budget) and
+    ``attend(layer, q, k, v)`` receives the chunk's per-head states
+    ([B, C, H, hd] each), must extend the caller's cache with
+    ``k``/``v`` and return each chunk position's causal attention over
+    the full cached prefix (earlier chunks included) as [B, C, H, hd].
+    Returns logits [B, C, V].
+
+    There is no positional embedding in this architecture — position
+    enters only through the attention mask — so the chunk's absolute
+    offset is entirely the attend closure's business (the serve tier
+    passes it to ``serve.kvcache.paged_prefill_attention``).
+    """
+    vocab, num_layers, d = lm_config_from_params(params)
+    if d % heads:
+        raise MXNetError(f"d_model {d} not divisible by heads {heads}")
+    hd = d // heads
+    b, c = tokens.shape
+    h = jnp.take(_param(params, "embed_weight"),
+                 tokens.astype(jnp.int32), axis=0)
+
+    def make_attend(i):
+        def _attend(q, k, v):
+            q, k, v = (t.reshape(b, c, heads, hd) for t in (q, k, v))
+            return attend(i, q, k, v).reshape(b, c, d)
+        return _attend
+
+    for i in range(num_layers):
+        h = _block_step(params, i, h, make_attend(i))
+    return _lm_head(params, h)
+
+
 def transformer_lm_decode(params, tokens, *, heads, attend):
     """One incremental decode step over a caller-owned KV cache.
 

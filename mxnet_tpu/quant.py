@@ -54,8 +54,8 @@ import jax.numpy as jnp
 from .base import MXNetError
 
 __all__ = [
-    "QuantConfig", "resolve_quant", "block_quantize", "fp8_dot",
-    "fp8_linear", "FP8_MAX", "WIRE_ITEMSIZE", "wire_itemsize",
+    "QuantConfig", "resolve_quant", "block_quantize", "rowwise_quantize",
+    "fp8_dot", "fp8_linear", "FP8_MAX", "WIRE_ITEMSIZE", "wire_itemsize",
     "error_feedback_default", "symbol_uses_fp8",
 ]
 
@@ -194,6 +194,25 @@ def block_quantize(x2d, fmt: str, block: int):
     scale = jnp.maximum(absmax, jnp.float32(1e-30)) / jnp.float32(FP8_MAX[fmt])
     q = (xb / scale).astype(_FP8_DTYPES[fmt])
     return q, scale
+
+
+def rowwise_quantize(x, fmt: str):
+    """Quantize ``[rows, ...]`` to fp8 with one f32 scale per leading-axis
+    row: returns ``(q, scale [rows])`` with ``q * scale ~= x`` rowwise.
+    Same scaling rule as :func:`block_quantize` (the row absmax lands on
+    the format's largest finite value, so the cast never overflows), but
+    the "block" is everything behind the leading axis — the layout the
+    paged KV-cache wants, where a row is one cached token position and
+    its H x head_dim states share a scale."""
+    if fmt not in _FP8_DTYPES:
+        raise MXNetError(f"rowwise_quantize: unknown fp8 format {fmt!r}, "
+                         f"expected one of {sorted(_FP8_DTYPES)}")
+    x32 = x.astype(jnp.float32)
+    reduce_axes = tuple(range(1, x32.ndim))
+    absmax = jnp.max(jnp.abs(x32), axis=reduce_axes)
+    scale = jnp.maximum(absmax, jnp.float32(1e-30)) / jnp.float32(FP8_MAX[fmt])
+    q = (x32 / scale.reshape(scale.shape + (1,) * (x32.ndim - 1)))
+    return q.astype(_FP8_DTYPES[fmt]), scale
 
 
 _FP8_DOT_OK: Optional[bool] = None

@@ -94,11 +94,17 @@ class EngineConfig:
     slo_admit_frac: float = 0.5
     seed: int = 0
     dtype: Any = jnp.float32
+    # -- round-12 tail-latency knobs (docs/serving.md) --
+    prefill_chunk: int = 0        # >0: chunked prefill, chunk budget;
+                                  # 0: whole-prompt bucket ladder
+    kv_quant: Optional[str] = None   # None (f32) | "fp8" (e4m3+scales)
+    attn_impl: str = "auto"       # auto | scan | dense | flash
+                                  # | flash_interpret
 
     @classmethod
     def from_env(cls, **overrides) -> "EngineConfig":
-        """Environment defaults (docs/env_vars.md round 11); explicit
-        kwargs win."""
+        """Environment defaults (docs/env_vars.md rounds 11-12);
+        explicit kwargs win."""
         env = dict(
             block_size=_env_int("MXNET_TPU_SERVE_BLOCK_SIZE", 16),
             num_blocks=_env_int("MXNET_TPU_SERVE_BLOCKS", 128),
@@ -106,6 +112,11 @@ class EngineConfig:
             max_queue=_env_int("MXNET_TPU_SERVE_MAX_QUEUE", 64),
             max_seq_len=_env_int("MXNET_TPU_SERVE_MAX_SEQ", 256),
             slo_ms=_env_float("MXNET_TPU_SERVE_SLO_MS", None),
+            prefill_chunk=_env_int("MXNET_TPU_SERVE_PREFILL_CHUNK", 0),
+            kv_quant=(os.environ.get("MXNET_TPU_SERVE_KV_QUANT", "")
+                      .strip().lower() or None),
+            attn_impl=(os.environ.get("MXNET_TPU_SERVE_ATTN", "")
+                       .strip().lower() or "auto"),
         )
         env.update(overrides)
         return cls(**env)
@@ -119,6 +130,21 @@ class EngineConfig:
                     f"{self.max_batch}")
             return bs
         return (self.max_batch,)
+
+    def resolved_attn_impl(self) -> str:
+        """Decode attention strategy.  ``"auto"`` picks the Pallas
+        flash-decode kernel on TPU and the one-shot gather ("dense")
+        elsewhere — on thunk-dispatch-bound backends (XLA:CPU) the
+        reference block scan's ~10 ops per block column, not HBM
+        bandwidth, dominates the decode step."""
+        impl = self.attn_impl
+        if impl == "auto":
+            return "flash" if jax.default_backend() == "tpu" else "dense"
+        if impl not in ("scan", "dense", "flash", "flash_interpret"):
+            raise MXNetError(
+                f"attn_impl {impl!r}: expected 'auto', 'scan', 'dense', "
+                "'flash', or 'flash_interpret'")
+        return impl
 
 
 class _AotProgram:
@@ -177,23 +203,38 @@ class Engine:
         self.head_dim = self.d_model // self.heads
         bs = config.block_size
         self.max_blocks = -(-config.max_seq_len // bs)
+        self.attn_impl = config.resolved_attn_impl()
+        self.kv_quant = config.kv_quant
+        self.prefill_chunk = int(config.prefill_chunk or 0)
+        if self.prefill_chunk < 0:
+            raise MXNetError(f"prefill_chunk must be >= 0, "
+                             f"got {self.prefill_chunk}")
         self.alloc = kvcache.BlockAllocator(config.num_blocks, bs)
         self.kpool, self.vpool = kvcache.make_pools(
             self.num_layers, config.num_blocks, bs, self.heads,
-            self.head_dim, dtype=config.dtype)
+            self.head_dim, dtype=config.dtype, quant=config.kv_quant)
         self.sched = Scheduler(config.max_batch, config.max_queue,
                                config.slo_ms, config.slo_admit_frac)
         if config.max_prompt_len > config.max_seq_len:
             raise MXNetError(
                 f"max_prompt_len {config.max_prompt_len} exceeds "
                 f"max_seq_len {config.max_seq_len}")
-        policy = cc.BucketPolicy(min_bucket=config.prompt_bucket_min,
-                                 factor=config.prompt_bucket_factor,
-                                 round_to=config.prompt_bucket_min)
-        # the ladder covers max_seq_len, not max_prompt_len: a preempted
-        # request re-prefills with prompt + already-generated tokens,
-        # which may exceed any fresh prompt's length
-        self.prompt_buckets = tuple(policy._ladder(config.max_seq_len))
+        if self.prefill_chunk:
+            # chunked prefill: ONE chunk shape replaces the whole
+            # geometric ladder — any prompt (or preemption re-prefill up
+            # to max_seq_len) is ingested as ceil(len / chunk) runs of
+            # the same program
+            self.prompt_buckets = tuple(
+                cc.BucketPolicy.fixed(self.prefill_chunk).buckets)
+        else:
+            policy = cc.BucketPolicy(min_bucket=config.prompt_bucket_min,
+                                     factor=config.prompt_bucket_factor,
+                                     round_to=config.prompt_bucket_min)
+            # the ladder covers max_seq_len, not max_prompt_len: a
+            # preempted request re-prefills with prompt +
+            # already-generated tokens, which may exceed any fresh
+            # prompt's length
+            self.prompt_buckets = tuple(policy._ladder(config.max_seq_len))
         self.decode_buckets = config.resolved_decode_buckets()
         self._base_key = jax.random.PRNGKey(config.seed)
         self._programs: Dict[Tuple[str, int], _AotProgram] = {}
@@ -201,10 +242,17 @@ class Engine:
         self.aot_stats = collections.Counter()
         self.requests: Dict[int, Request] = {}
         self.step_idx = 0
+        self._chunk_ms = 0.0   # EWMA chunk-prefill latency (SLO backlog)
         self._fingerprint = (
             f"serve:{self.vocab}:{self.num_layers}:{self.d_model}:"
             f"{self.heads}:bs{bs}:nb{config.num_blocks}:"
-            f"mb{self.max_blocks}:{np.dtype(config.dtype).name}")
+            f"mb{self.max_blocks}:{np.dtype(config.dtype).name}:"
+            f"pc{self.prefill_chunk}:kv{config.kv_quant or 'f32'}:"
+            f"{self.attn_impl}")
+        telemetry.gauge("kv_bytes_per_token").set(
+            kvcache.kv_bytes_per_token(self.num_layers, self.heads,
+                                       self.head_dim, config.kv_quant,
+                                       dtype=config.dtype))
 
     # -- weight loading ---------------------------------------------------
 
@@ -240,8 +288,48 @@ class Engine:
 
         return fn
 
+    def _make_chunk_prefill_fn(self, cb: int):
+        """Chunked prefill: ingest one [1, cb] slice of a prompt at
+        absolute offset ``start``, extending the paged cache, and sample
+        the first token (read only when this is the final chunk — the
+        sampled value is position-keyed at ``length``, identical to the
+        whole-prompt program's)."""
+        heads, nl = self.heads, self.num_layers
+        from ..models.transformer import transformer_lm_prefill_chunk
+
+        def fn(kpool, vpool, params, tokens, start, length, table_row,
+               key, temp, topk):
+            self.trace_counts[f"prefill_chunk@{cb}"] += 1
+            pools = [kpool, vpool]
+
+            def attend(i, q, k, v):
+                # write this chunk's K/V first: chunk positions attend
+                # causally over the whole cached prefix, themselves
+                # included (same order as the decode path)
+                pools[0] = kvcache.write_prefill(pools[0], i, k[0],
+                                                 table_row, length,
+                                                 start=start)
+                pools[1] = kvcache.write_prefill(pools[1], i, v[0],
+                                                 table_row, length,
+                                                 start=start)
+                out = kvcache.paged_prefill_attention(
+                    q[0], kvcache.layer_view(pools[0], i),
+                    kvcache.layer_view(pools[1], i), table_row, start,
+                    length)
+                return out[None]
+
+            logits = transformer_lm_prefill_chunk(params, tokens,
+                                                  heads=heads,
+                                                  attend=attend)
+            last = jnp.take(logits[0],
+                            jnp.clip(length - 1 - start, 0, cb - 1), axis=0)
+            tok = _sample_row(last, key, temp, topk, length)
+            return pools[0], pools[1], tok
+
+        return fn
+
     def _make_decode_fn(self, bb: int):
-        heads = self.heads
+        heads, impl = self.heads, self.attn_impl
 
         def fn(kpool, vpool, params, tokens, tables, lengths, slots,
                offsets, active, keys, temps, topks):
@@ -254,7 +342,9 @@ class Engine:
                 pools[1] = kvcache.write_decode(pools[1], i, v, slots,
                                                 offsets, active)
                 return kvcache.paged_attention(
-                    q, pools[0][i], pools[1][i], tables, lengths + 1)
+                    q, kvcache.layer_view(pools[0], i),
+                    kvcache.layer_view(pools[1], i), tables, lengths + 1,
+                    impl=impl)
 
             logits = transformer_lm_decode(params, tokens, heads=heads,
                                            attend=attend)
@@ -263,14 +353,24 @@ class Engine:
 
         return fn
 
+    def _pool_aval(self):
+        sds = jax.ShapeDtypeStruct
+        return jax.tree_util.tree_map(lambda a: sds(a.shape, a.dtype),
+                                      self.kpool)
+
     def _avals(self, kind: str, bucket: int):
         sds = jax.ShapeDtypeStruct
-        pool = sds(self.kpool.shape, self.kpool.dtype)
+        pool = self._pool_aval()
         params = {k: sds(v.shape, v.dtype) for k, v in self._params.items()}
         key = sds((2,), jnp.uint32)
         if kind == "prefill":
             return (pool, pool, params, sds((1, bucket), jnp.int32),
                     sds((), jnp.int32), sds((self.max_blocks,), jnp.int32),
+                    key, sds((), jnp.float32), sds((), jnp.int32))
+        if kind == "prefill_chunk":
+            return (pool, pool, params, sds((1, bucket), jnp.int32),
+                    sds((), jnp.int32), sds((), jnp.int32),
+                    sds((self.max_blocks,), jnp.int32),
                     key, sds((), jnp.float32), sds((), jnp.int32))
         b = bucket
         i32 = lambda *s: sds(s, jnp.int32)
@@ -282,8 +382,9 @@ class Engine:
         pkey = (kind, bucket)
         if pkey in self._programs:
             return {"source": "ready", "kind": kind, "bucket": bucket}
-        make = (self._make_prefill_fn if kind == "prefill"
-                else self._make_decode_fn)
+        make = {"prefill": self._make_prefill_fn,
+                "prefill_chunk": self._make_chunk_prefill_fn,
+                "decode": self._make_decode_fn}[kind]
         jit_fn = jax.jit(make(bucket), donate_argnums=(0, 1))
         avals = self._avals(kind, bucket)
         ckey = cc.program_key(self._fingerprint, avals, donate=(0, 1),
@@ -300,7 +401,8 @@ class Engine:
         compile cache.  After this, steady-state serving runs zero
         traces (``trace_counts`` stays flat — pinned by tests)."""
         with telemetry.span("serve.warmup"):
-            infos = [self._ensure_program("prefill", lb)
+            pkind = "prefill_chunk" if self.prefill_chunk else "prefill"
+            infos = [self._ensure_program(pkind, lb)
                      for lb in self.prompt_buckets]
             infos += [self._ensure_program("decode", bb)
                       for bb in self.decode_buckets]
@@ -409,9 +511,16 @@ class Engine:
                 self._finish(req, "cancelled", CANCELLED)
         with telemetry.span("serve.admit", step=self.step_idx,
                             queued=self.sched.queue_depth):
-            admitted = self.sched.admit(self._admission_gate(), now)
-        for req in admitted:
-            self._prefill(req)
+            admitted = self.sched.admit(
+                self._admission_gate(), now,
+                prefill_backlog_ms=self._prefill_backlog_ms())
+        if self.prefill_chunk:
+            for req in admitted:
+                self._prefill_begin(req)
+            self._prefill_pump()
+        else:
+            for req in admitted:
+                self._prefill(req)
         if self.sched.running:
             self._decode_step()
         telemetry.gauge("serve.queue_depth").set(self.sched.queue_depth)
@@ -459,10 +568,92 @@ class Engine:
                 np.int32(plen), table_row, req.key,
                 np.float32(req.temperature), np.int32(req.top_k))
         req.cached = plen
+        req.prefilled = req.prefill_target = plen
         telemetry.counter("serve.prefills").inc()
         telemetry.histogram("serve.prefill_ms").observe(
             (time.monotonic() - t0) * 1e3)
         self._append_token(req, int(tok))
+
+    # -- chunked prefill (round 12) ---------------------------------------
+
+    def _prefill_begin(self, req: Request) -> None:
+        """Admit-time half of chunked prefill: reserve the blocks the
+        whole prompt needs (the admission gate already accounted for
+        them) and arm the chunk pump; no device work yet."""
+        toks = req.seed_tokens
+        req.prefill_target = len(toks)
+        req.prefilled = 0
+        req.cached = 0
+        req.blocks = self.alloc.alloc(
+            self.alloc.blocks_for_tokens(len(toks)), req.id)
+
+    def _prefill_pump(self) -> None:
+        """Run prefill chunks for mid-prefill requests, oldest first.
+
+        While any request is decode-ready, at most ONE chunk runs per
+        engine step — that is the whole point of chunked prefill: the
+        stall a prefill injects into in-flight decodes is bounded by the
+        chunk budget, not the longest admitted prompt.  (Running more
+        chunks per step when few requests decode amortizes fine in
+        aggregate but lands multi-chunk stalls on exactly the intervals
+        the p99 ITL contract protects — measured in docs/perf.md r12.)
+        When nothing can decode yet (engine start, or every slot
+        mid-prefill) the pump keeps going until one request completes,
+        since there is no decode to stall.
+        """
+        while True:
+            pending = [r for r in self.sched.running
+                       if r.prefilled < r.prefill_target]
+            if not pending:
+                return
+            self._prefill_chunk_step(pending[0])
+            if any(r.prefilled >= r.prefill_target
+                   for r in self.sched.running):
+                return
+
+    def _prefill_chunk_step(self, req: Request) -> None:
+        cb = self.prefill_chunk
+        start = req.prefilled
+        plen = req.prefill_target
+        toks = req.seed_tokens[start:start + cb]
+        self._ensure_program("prefill_chunk", cb)
+        padded = np.zeros((1, cb), np.int32)
+        padded[0, :len(toks)] = toks
+        table_row = np.zeros((self.max_blocks,), np.int32)
+        table_row[:len(req.blocks)] = req.blocks
+        t0 = time.monotonic()
+        with telemetry.span("serve.prefill", req=req.id, bucket=cb,
+                            prompt=plen, chunk_start=start,
+                            chunk_budget=cb):
+            self.kpool, self.vpool, tok = (
+                self._programs[("prefill_chunk", cb)](
+                    self.kpool, self.vpool, self._params, padded,
+                    np.int32(start), np.int32(plen), table_row, req.key,
+                    np.float32(req.temperature), np.int32(req.top_k)))
+        ms = (time.monotonic() - t0) * 1e3
+        self._chunk_ms = (ms if self._chunk_ms == 0.0
+                          else 0.8 * self._chunk_ms + 0.2 * ms)
+        req.prefilled = min(start + cb, plen)
+        req.cached = req.prefilled
+        telemetry.counter("serve.prefill_chunks").inc()
+        telemetry.histogram("serve.prefill_ms").observe(ms)
+        if req.prefilled >= plen:
+            telemetry.counter("serve.prefills").inc()
+            self._append_token(req, int(tok))
+
+    def _prefill_backlog_ms(self) -> float:
+        """Expected serialization delay from remaining prefill chunks of
+        already-admitted requests — wait a queued request will certainly
+        absorb before its own prefill, credited to its SLO clock so the
+        chunk pump cannot silently starve at-risk requests of their
+        admission jump."""
+        if not self.prefill_chunk or not self._chunk_ms:
+            return 0.0
+        remaining = sum(
+            -(-(r.prefill_target - r.prefilled) // self.prefill_chunk)
+            for r in self.sched.running
+            if r.prefilled < r.prefill_target)
+        return remaining * self._chunk_ms
 
     def _grow_blocks(self, req: Request) -> bool:
         """Ensure the request owns a block for cache index ``cached``.
@@ -486,16 +677,23 @@ class Engine:
         self.alloc.free(victim.blocks)
         victim.blocks = []
         victim.cached = 0
+        victim.prefilled = 0
+        victim.prefill_target = 0
         self.sched.requeue(victim)
 
     def _decode_step(self) -> None:
         # growth pass first: a preemption inside _grow_blocks mutates
         # sched.running, so the batch roster is only read afterwards
-        # (a preempted victim must not decode on freed blocks)
+        # (a preempted victim must not decode on freed blocks).
+        # Mid-prefill requests (chunked prefill still ingesting) hold
+        # blocks for their whole prompt already and have no last token
+        # to feed — they stay off the decode roster until the pump
+        # finishes them.
         for req in list(self.sched.running):
-            if req in self.sched.running:
+            if req in self.sched.running and req.prefilled >= req.prefill_target:
                 self._grow_blocks(req)
-        active = list(self.sched.running)
+        active = [r for r in self.sched.running
+                  if r.prefilled >= r.prefill_target]
         if not active:
             return
         bb = cc.bucket_for(len(active), self.decode_buckets)
@@ -586,4 +784,7 @@ class Engine:
             "steps": self.step_idx,
             "prompt_buckets": list(self.prompt_buckets),
             "decode_buckets": list(self.decode_buckets),
+            "prefill_chunk": self.prefill_chunk,
+            "kv_quant": self.kv_quant,
+            "attn_impl": self.attn_impl,
         }

@@ -12,15 +12,26 @@ The device side is three pure functions, all shape-static so the serve
 engine's decode program never retraces:
 
 * :func:`paged_attention` — one query token per request attends over its
-  table-addressed blocks with the same online-softmax block scan as
+  table-addressed blocks.  The reference ``impl="scan"`` runs the same
+  online-softmax block scan as
   ``parallel/ring_attention.blockwise_attention`` / the flash kernels
-  (running max / sum / accumulator in f32, ``NEG_INF`` masking).  Blocks
-  are gathered straight out of the pool per scan step; the padded dense
-  [B, L_max] score matrix is never materialized.
+  (running max / sum / accumulator in f32, ``NEG_INF`` masking);
+  ``impl="dense"`` gathers all blocks at once for thunk-bound backends,
+  and ``impl="flash"`` dispatches the Pallas flash-decode kernel
+  (``serve/flash_decode.py``).
+* :func:`paged_prefill_attention` — causal attention for one **prefill
+  chunk** (round-12 chunked prefill): C query positions against the
+  request's whole cached prefix.
 * :func:`write_prefill` / :func:`write_decode` — functional scatters of
   freshly-computed K/V states into table-addressed slots.  Padded or
   inactive rows are redirected to the reserved **trash block 0** so the
   scatter itself stays branch-free.
+
+Round-12 adds **fp8-e4m3 quantized pools** (:class:`QuantPool`): the
+payload stores 1 byte/element plus one f32 scale per cached position
+(``quant.rowwise_quantize`` — the KV variant of the r9 block-scale
+machinery), halving cache bytes per token; every read path dequantizes
+to f32 at the gather.
 
 The host side is :class:`BlockAllocator`: a free-list allocator with
 alloc/free/defrag and per-request ownership tracking (table integrity is
@@ -33,7 +44,7 @@ identical shapes.
 """
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -41,15 +52,83 @@ import numpy as np
 
 from ..base import MXNetError
 from ..parallel.flash_attention import NEG_INF
+from .. import quant as quantmod
 
-__all__ = ["TRASH_BLOCK", "BlockAllocator", "make_pools",
-           "paged_attention", "dense_attention", "write_prefill",
-           "write_decode", "compact_pool"]
+__all__ = ["TRASH_BLOCK", "KV_QUANT_FORMATS", "QuantPool", "BlockAllocator",
+           "make_pools", "is_quantized", "layer_view", "pool_nbytes",
+           "kv_bytes_per_token", "paged_attention", "paged_prefill_attention",
+           "dense_attention", "write_prefill", "write_decode", "compact_pool"]
 
 #: physical slot 0 is never handed out: padded prefill positions and
 #: inactive decode rows scatter their garbage there, keeping every
 #: device-side write unconditional (no retrace-prone masking branches).
 TRASH_BLOCK = 0
+
+#: supported quantized-pool storage formats ("fp8" = e4m3 payload + one
+#: f32 scale per cached position; see :class:`QuantPool`).
+KV_QUANT_FORMATS = ("fp8",)
+
+#: fp8 wire format used for quantized pools — e4m3 (the activation
+#: format of the r9 compute policy): KV states are forward-path values,
+#: so mantissa beats the e5m2 dynamic range.
+KV_FP8_FORMAT = "e4m3"
+
+
+class QuantPool(NamedTuple):
+    """A quantized KV pool: fp8-e4m3 payload plus per-position f32
+    scales, quantized with :func:`mxnet_tpu.quant.rowwise_quantize` (one
+    scale per cached token position per layer — the row absmax lands on
+    the fp8 format max, so the cast never overflows).
+
+    ``payload``: ``[num_layers, num_blocks, block_size, heads, head_dim]``
+    fp8; ``scale``: ``[num_layers, num_blocks, block_size]`` f32.  A
+    NamedTuple so the pair rides through jit/donation as one pytree —
+    every pool-taking function here accepts either a plain array pool or
+    a ``QuantPool`` and dispatches on the type.
+    """
+    payload: jax.Array
+    scale: jax.Array
+
+
+Pool = Union[jax.Array, QuantPool]
+
+
+def is_quantized(pool) -> bool:
+    return isinstance(pool, QuantPool)
+
+
+def layer_view(pool: Pool, layer: int) -> Pool:
+    """One layer's slice of a pool, preserving quantization structure:
+    ``[num_blocks, BS, H, hd]`` (array) or the matching ``QuantPool``
+    of ``(payload, scale[num_blocks, BS])``."""
+    if is_quantized(pool):
+        return QuantPool(pool.payload[layer], pool.scale[layer])
+    return pool[layer]
+
+
+def pool_nbytes(*pools: Pool) -> int:
+    """Device bytes held by the given pools (payload + scales)."""
+    total = 0
+    for pool in pools:
+        for leaf in jax.tree_util.tree_leaves(pool):
+            total += int(np.prod(leaf.shape)) * jnp.dtype(leaf.dtype).itemsize
+    return total
+
+
+def kv_bytes_per_token(num_layers: int, heads: int, head_dim: int,
+                       quant: Optional[str] = None,
+                       dtype=jnp.float32) -> int:
+    """HBM bytes one cached token position occupies across both pools
+    (K and V, all layers) — the number the decode path streams per
+    token per request.  fp8 pools pay 1 byte/element plus one f32 scale
+    per (layer, position, pool)."""
+    per_pos = heads * head_dim
+    if quant is None:
+        return 2 * num_layers * per_pos * jnp.dtype(dtype).itemsize
+    if quant not in KV_QUANT_FORMATS:
+        raise MXNetError(f"unknown kv quant format {quant!r}, expected one "
+                         f"of {KV_QUANT_FORMATS} or None")
+    return 2 * num_layers * (per_pos * 1 + 4)
 
 
 # ---------------------------------------------------------------------------
@@ -163,12 +242,42 @@ class BlockAllocator:
 # ---------------------------------------------------------------------------
 
 def make_pools(num_layers: int, num_blocks: int, block_size: int,
-               heads: int, head_dim: int,
-               dtype=jnp.float32) -> Tuple[jax.Array, jax.Array]:
+               heads: int, head_dim: int, dtype=jnp.float32,
+               quant: Optional[str] = None) -> Tuple[Pool, Pool]:
     """Preallocate the K and V pools:
-    ``[num_layers, num_blocks, block_size, heads, head_dim]``."""
+    ``[num_layers, num_blocks, block_size, heads, head_dim]``.
+
+    ``quant="fp8"`` returns :class:`QuantPool` pairs instead — e4m3
+    payload plus per-position f32 scales — halving cache bytes per token
+    (4B -> 1B payload + amortized scale).  Each pool gets its own fresh
+    buffers: the engine donates both, and aliased donations are illegal.
+    """
     shape = (num_layers, num_blocks, block_size, heads, head_dim)
-    return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+    if quant is None:
+        return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+    if quant not in KV_QUANT_FORMATS:
+        raise MXNetError(f"unknown kv quant format {quant!r}, expected one "
+                         f"of {KV_QUANT_FORMATS} or None")
+    fp8 = quantmod._FP8_DTYPES[KV_FP8_FORMAT]
+    def one():
+        return QuantPool(jnp.zeros(shape, fp8),
+                         jnp.zeros(shape[:3], jnp.float32))
+    return one(), one()
+
+
+def _block_size_of(pool: Pool) -> int:
+    return (pool.payload if is_quantized(pool) else pool).shape[-3]
+
+
+def _gather_blocks(pool: Pool, idx):
+    """Gather physical blocks by slot index, dequantizing fp8 payloads
+    to f32 against their per-position scales.  ``idx`` may be any int
+    shape; the result is ``idx.shape + [BS, H, hd]``."""
+    if is_quantized(pool):
+        q = jnp.take(pool.payload, idx, axis=0)
+        s = jnp.take(pool.scale, idx, axis=0)
+        return q.astype(jnp.float32) * s[..., None, None]
+    return jnp.take(pool, idx, axis=0)
 
 
 def _attend_blocks(q, read_block, nblk: int, block_size: int, lengths,
@@ -206,26 +315,103 @@ def _attend_blocks(q, read_block, nblk: int, block_size: int, lengths,
 
 
 def paged_attention(q, k_pool, v_pool, tables, lengths, *,
-                    scale: Optional[float] = None):
+                    scale: Optional[float] = None, impl: str = "scan"):
     """One-token-per-request attention over a paged cache.
 
     ``q``: [B, H, hd] query states; ``k_pool``/``v_pool``:
-    [num_blocks, BS, H, hd] (one layer's pool); ``tables``:
-    [B, max_blocks] int32 physical slot per logical block (unused
-    entries may hold any valid slot — the length mask kills them);
-    ``lengths``: [B] int32 valid cache entries (including the current
-    token, which must already be written).  Returns [B, H, hd].
+    [num_blocks, BS, H, hd] (one layer's pool, plain or
+    :class:`QuantPool`); ``tables``: [B, max_blocks] int32 physical slot
+    per logical block (unused entries may hold any valid slot — the
+    length mask kills them); ``lengths``: [B] int32 valid cache entries
+    (including the current token, which must already be written).
+    Returns [B, H, hd].
+
+    ``impl`` selects the read strategy (docs/serving.md "tail-latency
+    tuning"):
+
+    * ``"scan"`` — the reference online-softmax block scan (one gather
+      + softmax update per block column; the dense [B, L_max] score
+      matrix is never materialized).
+    * ``"dense"`` — gather every table-addressed block in one shot and
+      run a single masked softmax over [B, L_max].  ~10 ops instead of
+      ~10·nblk: on CPU (and any thunk-dispatch-bound backend) the scan's
+      per-block op chain, not HBM, is the decode bottleneck.  L_max here
+      is table capacity — a few hundred positions — so the materialized
+      scores are tiny.
+    * ``"flash"`` / ``"flash_interpret"`` — the Pallas flash-decode
+      kernel (``serve/flash_decode.py``): streams each KV block through
+      VMEM once, split-K across blocks for long contexts.  The interpret
+      variant runs the same kernel on the CPU backend for tests.
     """
     b, h, d = q.shape
     nblk = tables.shape[1]
-    bs = k_pool.shape[1]
+    bs = _block_size_of(k_pool)
     scale_ = (1.0 / np.sqrt(d)) if scale is None else scale
+
+    if impl in ("flash", "flash_interpret"):
+        from .flash_decode import flash_decode_attention
+        return flash_decode_attention(
+            q, k_pool, v_pool, tables, lengths, scale=scale_,
+            interpret=(impl == "flash_interpret"))
+
+    if impl == "dense":
+        f32 = jnp.float32
+        k = _gather_blocks(k_pool, tables).reshape(b, nblk * bs, h, d)
+        v = _gather_blocks(v_pool, tables).reshape(b, nblk * bs, h, d)
+        s = jnp.einsum("bhd,blhd->bhl", q, k).astype(f32) * scale_
+        valid = jnp.arange(nblk * bs)[None, :] < lengths[:, None]
+        s = jnp.where(valid[:, None, :], s, NEG_INF)
+        m = jnp.max(s, axis=-1)
+        p = jnp.where(valid[:, None, :], jnp.exp(s - m[..., None]), 0.0)
+        l = jnp.maximum(jnp.sum(p, axis=-1), 1e-30)
+        out = jnp.einsum("bhl,blhd->bhd", p, v.astype(f32))
+        return (out / l[..., None]).astype(q.dtype)
+
+    if impl != "scan":
+        raise MXNetError(f"paged_attention: unknown impl {impl!r}, expected "
+                         "'scan', 'dense', 'flash', or 'flash_interpret'")
 
     def read_block(j):
         slot = tables[:, j]
-        return jnp.take(k_pool, slot, axis=0), jnp.take(v_pool, slot, axis=0)
+        return _gather_blocks(k_pool, slot), _gather_blocks(v_pool, slot)
 
     return _attend_blocks(q, read_block, nblk, bs, lengths, scale_)
+
+
+def paged_prefill_attention(q, k_pool, v_pool, table_row, start, length, *,
+                            scale: Optional[float] = None):
+    """Causal attention for one **prefill chunk** over a paged cache.
+
+    ``q``: [C, H, hd] — the chunk's query states at absolute positions
+    ``start .. start+C-1``; ``table_row``: [max_blocks] int32 — one
+    request's block table; ``length``: scalar — total valid cache
+    entries (the chunk's own K/V must already be written, so position
+    ``p`` of the chunk may attend to every cached position ``<= start+p``).
+    Returns [C, H, hd].
+
+    Materializes the [C, L_max] score matrix (L_max = table capacity ·
+    block size — one request's cache, tiny), dequantizing fp8 pools on
+    the gather.  Padded chunk positions (``start+p >= length``) produce
+    garbage rows; the engine's sampler only reads the row holding the
+    prompt's last token.
+    """
+    c, h, d = q.shape
+    nblk = table_row.shape[0]
+    bs = _block_size_of(k_pool)
+    scale_ = (1.0 / np.sqrt(d)) if scale is None else scale
+    f32 = jnp.float32
+    k = _gather_blocks(k_pool, table_row).reshape(nblk * bs, h, d)
+    v = _gather_blocks(v_pool, table_row).reshape(nblk * bs, h, d)
+    s = jnp.einsum("chd,lhd->chl", q, k).astype(f32) * scale_
+    pos = jnp.arange(nblk * bs)
+    qpos = start + jnp.arange(c)
+    valid = (pos[None, :] <= qpos[:, None]) & (pos[None, :] < length)
+    s = jnp.where(valid[:, None, :], s, NEG_INF)
+    m = jnp.max(s, axis=-1)
+    p = jnp.where(valid[:, None, :], jnp.exp(s - m[..., None]), 0.0)
+    l = jnp.maximum(jnp.sum(p, axis=-1), 1e-30)
+    out = jnp.einsum("chl,lhd->chd", p, v.astype(f32))
+    return (out / l[..., None]).astype(q.dtype)
 
 
 def dense_attention(q, k_buf, v_buf, lengths, *, block_size: int,
@@ -250,24 +436,35 @@ def dense_attention(q, k_buf, v_buf, lengths, *, block_size: int,
     return _attend_blocks(q, read_block, nblk, block_size, lengths, scale_)
 
 
-def write_prefill(pool, layer: int, states, table_row, length):
-    """Scatter a prompt's K or V states into its table's slots.
+def write_prefill(pool, layer: int, states, table_row, length, start=0):
+    """Scatter a prompt's (or prompt chunk's) K or V states into its
+    table's slots.
 
-    ``pool``: [layers, nblocks, BS, H, hd]; ``states``: [L_pad, H, hd]
-    (bucket-padded); ``table_row``: [max_blocks] int32; ``length``:
-    scalar valid positions.  Positions ``>= length`` land in the trash
-    block.  Returns the updated pool (functional; donate the input).
+    ``pool``: [layers, nblocks, BS, H, hd] (plain or :class:`QuantPool`);
+    ``states``: [L_pad, H, hd] (bucket- or chunk-padded); ``table_row``:
+    [max_blocks] int32; ``length``: scalar total valid positions;
+    ``start``: absolute position of ``states[0]`` (chunked prefill
+    writes chunk *i* with ``start = i * chunk``).  Positions
+    ``>= length`` land in the trash block.  Returns the updated pool
+    (functional; donate the input).  Quantized pools quantize each
+    position row (fp8 payload + f32 scale) and scatter both with the
+    same indices.
     """
     lpad = states.shape[0]
-    bs = pool.shape[2]
-    pos = jnp.arange(lpad)
+    bs = _block_size_of(pool)
+    pos = start + jnp.arange(lpad)
     logical = pos // bs
     # bucket L_pad may exceed table capacity * BS for short prompts;
     # clamp the logical index — those positions are >= length anyway.
     logical = jnp.minimum(logical, table_row.shape[0] - 1)
     slot = jnp.where(pos < length, jnp.take(table_row, logical),
                      TRASH_BLOCK)
-    return pool.at[layer, slot, pos % bs].set(states)
+    off = pos % bs
+    if is_quantized(pool):
+        q, s = quantmod.rowwise_quantize(states, KV_FP8_FORMAT)
+        return QuantPool(pool.payload.at[layer, slot, off].set(q),
+                         pool.scale.at[layer, slot, off].set(s))
+    return pool.at[layer, slot, off].set(states)
 
 
 def write_decode(pool, layer: int, states, slots, offsets, active):
@@ -278,6 +475,10 @@ def write_decode(pool, layer: int, states, slots, offsets, active):
     inactive rows write to the trash block.  Returns the updated pool.
     """
     slot = jnp.where(active, slots, TRASH_BLOCK)
+    if is_quantized(pool):
+        q, s = quantmod.rowwise_quantize(states, KV_FP8_FORMAT)
+        return QuantPool(pool.payload.at[layer, slot, offsets].set(q),
+                         pool.scale.at[layer, slot, offsets].set(s))
     return pool.at[layer, slot, offsets].set(states)
 
 
@@ -285,9 +486,11 @@ def compact_pool(pool, mapping: Dict[int, int]):
     """Apply a :meth:`BlockAllocator.defrag` relocation map to a pool:
     copy each moved slot's contents to its new physical index.  Values
     are moved, never transformed, so post-defrag attention output is
-    bitwise identical (gather of the same values)."""
+    bitwise identical (gather of the same values) — for quantized pools
+    payload and scales relocate together."""
     if not mapping:
         return pool
     src = jnp.asarray(sorted(mapping), jnp.int32)
     dst = jnp.asarray([mapping[int(s)] for s in sorted(mapping)], jnp.int32)
-    return pool.at[:, dst].set(pool[:, src])
+    return jax.tree_util.tree_map(
+        lambda a: a.at[:, dst].set(a[:, src]), pool)

@@ -54,6 +54,11 @@ class Request:
     tokens: List[int] = field(default_factory=list)   # generated ids
     blocks: List[int] = field(default_factory=list)   # physical kv slots
     cached: int = 0                   # kv entries currently stored
+    # chunked-prefill progress (engine-managed): seed tokens ingested so
+    # far vs the total to ingest.  Whole-prompt prefill sets both at
+    # once; a preempted request resets both and re-chunks on re-admit.
+    prefilled: int = 0
+    prefill_target: int = 0
     cancel_requested: bool = False
     finish_reason: Optional[str] = None
     submit_t: float = 0.0
@@ -123,33 +128,46 @@ class Scheduler:
     def _slo(self, req: Request) -> Optional[float]:
         return req.slo_ms if req.slo_ms is not None else self.slo_ms
 
-    def _at_risk(self, req: Request, now: float) -> bool:
+    def _at_risk(self, req: Request, now: float,
+                 backlog_ms: float = 0.0) -> bool:
+        """Whether a queued request has burned through
+        ``slo_admit_frac`` of its budget.  ``backlog_ms`` is wait the
+        request will *certainly* still absorb before its first token —
+        the engine passes the remaining prefill-chunk backlog of
+        already-active requests, so chunked prefill (which serializes
+        one chunk per step ahead of new admissions) cannot silently eat
+        an at-risk request's admission jump."""
         slo = self._slo(req)
         if slo is None:
             return False
-        return (now - req.submit_t) * 1e3 >= slo * self.slo_admit_frac
+        wait = (now - req.submit_t) * 1e3 + backlog_ms
+        return wait >= slo * self.slo_admit_frac
 
-    def admission_order(self, now: Optional[float] = None) -> List[Request]:
+    def admission_order(self, now: Optional[float] = None,
+                        prefill_backlog_ms: float = 0.0) -> List[Request]:
         """Queue in the order admission will consider it: SLO-at-risk
-        first (least remaining slack first), then FIFO."""
+        first (least remaining slack first), then FIFO.  Slack is
+        discounted by ``prefill_backlog_ms`` (see :meth:`_at_risk`)."""
         now = time.monotonic() if now is None else now
 
         def sort_key(req):
-            if self._at_risk(req, now):
-                slack = self._slo(req) - (now - req.submit_t) * 1e3
+            if self._at_risk(req, now, prefill_backlog_ms):
+                slack = (self._slo(req)
+                         - (now - req.submit_t) * 1e3 - prefill_backlog_ms)
                 return (0, slack, self._order[req.id])
             return (1, 0.0, self._order[req.id])
 
         return sorted(self.queue, key=sort_key)
 
     def admit(self, can_place: Callable[[Request], bool],
-              now: Optional[float] = None) -> List[Request]:
+              now: Optional[float] = None,
+              prefill_backlog_ms: float = 0.0) -> List[Request]:
         """Move requests from the queue into free decode slots.  Stops
         at the first candidate ``can_place`` rejects (strict order —
         no starvation by smaller latecomers)."""
         now = time.monotonic() if now is None else now
         admitted: List[Request] = []
-        for req in self.admission_order(now):
+        for req in self.admission_order(now, prefill_backlog_ms):
             if len(self.running) >= self.max_batch:
                 break
             if not can_place(req):

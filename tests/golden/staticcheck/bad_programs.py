@@ -139,6 +139,44 @@ def hbm_bytes_quantized():
     return tr, {"expect_wire_itemsize": 1}
 
 
+def _decode_read(quant):
+    """Trace one layer's paged decode-attention read (the dense impl's
+    table gather) over a pool that is f32 or fp8-quantized."""
+    from mxnet_tpu.serve import kvcache
+    nb, bs, h, hd, b, mb = 16, 8, 2, 16, 2, 4
+
+    if quant:
+        pool = kvcache.QuantPool(
+            _SDS((nb, bs, h, hd), jnp.float8_e4m3fn),
+            _SDS((nb, bs), jnp.float32))
+    else:
+        pool = _SDS((nb, bs, h, hd), jnp.float32)
+
+    def step(q, kp, vp, tables, lengths):
+        return kvcache.paged_attention(q, kp, vp, tables, lengths,
+                                       impl="dense")
+
+    return jax.jit(step).trace(
+        _SDS((b, h, hd), jnp.float32), pool, pool,
+        _SDS((b, mb), jnp.int32), _SDS((b,), jnp.int32))
+
+
+def decode_kv_widened():
+    """The r12 regression class: an engine configured for fp8 KV pools
+    whose decode program gathers a full-width f32 pool — the quantize
+    was silently dropped and the step streams 4x the contracted KV
+    bytes/token."""
+    return _decode_read(quant=False), {"expect_kv_itemsize": 1}
+
+
+def decode_kv_quantized():
+    """Negative control for ``expect_kv_itemsize``: the pool-shaped
+    gathers read the 1-byte e4m3 payload (the f32 scales are rank-2
+    gathers, outside the KV-read shape filter), so the audit stays
+    silent."""
+    return _decode_read(quant=True), {"expect_kv_itemsize": 1}
+
+
 PROGRAMS = {
     "carry_widen": (carry_widen, ["program.carry-widen", "program.widen"]),
     "host_transfer": (host_transfer, ["program.host-transfer"]),
@@ -149,4 +187,6 @@ PROGRAMS = {
     "fused_clean": (fused_clean, []),
     "hbm_bytes_widened": (hbm_bytes_widened, ["program.hbm-bytes"]),
     "hbm_bytes_quantized": (hbm_bytes_quantized, []),
+    "decode_kv_widened": (decode_kv_widened, ["program.hbm-bytes"]),
+    "decode_kv_quantized": (decode_kv_quantized, []),
 }

@@ -573,3 +573,197 @@ def test_predictor_create_from_manifest_with_aot(tmp_path):
     # a second predictor re-attaches warm (memory hit, no new compile)
     p2 = pred.create(str(tmp_path / "ckpt"), input_shapes=shapes)
     assert p2.aot_info[0]["source"] in ("memory", "disk")
+
+
+# ---------------------------------------------------------------------------
+# Round 12: chunked prefill, fp8 KV pools, decode-attention impls
+# ---------------------------------------------------------------------------
+
+def test_chunked_prefill_matches_unchunked():
+    """Chunked prompt ingestion is a pure scheduling change: every
+    request emits token-for-token what the whole-prompt engine emits —
+    greedy and seeded-sampled rows alike, prompts spanning 1..2 chunks
+    and a mid-chunk tail."""
+    alone = _alone_outputs()
+    eng = _engine(prefill_chunk=4)
+    ids = [eng.submit(p, **k) for p, k in zip(_PROMPTS, _KW)]
+    assert [eng.result(i) for i in ids] == alone
+
+
+def test_chunked_prefill_batched_vs_alone():
+    chunked_alone = []
+    for p, k in zip(_PROMPTS, _KW):
+        e = _engine(prefill_chunk=4)
+        chunked_alone.append(e.result(e.submit(p, **k)))
+    assert chunked_alone == _alone_outputs()
+    eng = _engine(prefill_chunk=4)
+    ids = [eng.submit(p, **k) for p, k in zip(_PROMPTS, _KW)]
+    assert [eng.result(i) for i in ids] == chunked_alone
+
+
+def test_chunked_ladder_collapses_to_two_programs():
+    """The whole geometric prompt ladder becomes ONE chunk shape: a
+    warmed chunked engine holds exactly two programs — the chunk and
+    the decode bucket."""
+    eng = _engine(prefill_chunk=8)
+    assert eng.prompt_buckets == (8,)
+    eng.warmup()
+    assert sorted(eng._programs) == [("decode", 4), ("prefill_chunk", 8)]
+    ladder = _engine()
+    assert len(ladder.prompt_buckets) > 1         # the r10 ladder
+
+
+def test_chunked_zero_trace_warm_cycle():
+    eng = _engine(prefill_chunk=4)
+    eng.warmup()
+    snap = dict(eng.trace_counts)
+    ids = [eng.submit(p, **k) for p, k in zip(_PROMPTS, _KW)]
+    eng.run()
+    assert all(eng.requests[i].done() for i in ids)
+    assert dict(eng.trace_counts) == snap         # ZERO new traces
+    assert eng.alloc.num_used == 0
+
+
+def test_chunked_mid_prefill_preemption_replay():
+    """Preempting a request while only part of its prompt is ingested
+    must reset the chunk cursor: on re-admission it re-chunks from
+    position 0 and still replays its exact stream."""
+    prompts = [list(range(1, 15)), list(range(20, 30))]
+    kws = [dict(max_new_tokens=8, temperature=0.8, seed=55),
+           dict(max_new_tokens=6, seed=66)]
+    refs = []
+    for p, k in zip(prompts, kws):
+        e = _engine(prefill_chunk=4)
+        refs.append(e.result(e.submit(p, **k)))
+    eng = _engine(prefill_chunk=4)
+    a = eng.submit(prompts[0], **kws[0])
+    b = eng.submit(prompts[1], **kws[1])
+    eng.step()     # nothing decodable: pump drains A's prompt fully
+    eng.step()     # A decodes; strict pump lands ONE chunk of B
+    req_b = eng.requests[b]
+    assert 0 < req_b.prefilled < req_b.prefill_target   # mid-prefill
+    eng._preempt(req_b)
+    assert req_b.prefilled == 0 and req_b.prefill_target == 0
+    eng.run()
+    assert [eng.requests[a].tokens, eng.requests[b].tokens] == refs
+
+
+def test_fp8_kv_engine_replay_and_greedy_parity():
+    """fp8-quantized pools serve deterministically (same tokens on
+    every run) and, at this scale, greedily match the f32 engine."""
+    runs = []
+    for _ in range(2):
+        eng = _engine(prefill_chunk=4, kv_quant="fp8")
+        ids = [eng.submit(p, **k) for p, k in zip(_PROMPTS, _KW)]
+        runs.append([eng.result(i) for i in ids])
+    assert runs[0] == runs[1]
+    f32 = _engine()
+    greedy = [i for i, k in enumerate(_KW) if "temperature" not in k]
+    refs = [f32.result(f32.submit(_PROMPTS[i], **_KW[i])) for i in greedy]
+    assert [runs[0][i] for i in greedy] == refs
+
+
+def test_fp8_kv_logit_error_bound():
+    """Accuracy contract: attention read from an fp8 pool stays within
+    a small bound of the f32-pool read (per-block e4m3 scales)."""
+    from mxnet_tpu.quant import rowwise_quantize
+    q, kd, vd, kp, vp, tables, lengths, BS = _paged_setup()
+    f32 = np.asarray(kvcache.paged_attention(
+        jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+        jnp.asarray(tables), jnp.asarray(lengths), impl="dense"))
+
+    def quantize(pool):
+        npool, bs = pool.shape[:2]
+        pay, sc = rowwise_quantize(
+            jnp.asarray(pool.reshape(npool * bs, -1)), "e4m3")
+        return kvcache.QuantPool(pay.reshape(pool.shape),
+                                 sc.reshape(npool, bs))
+
+    fp8 = np.asarray(kvcache.paged_attention(
+        jnp.asarray(q), quantize(kp), quantize(vp), jnp.asarray(tables),
+        jnp.asarray(lengths), impl="dense"))
+    assert 0 < np.max(np.abs(fp8 - f32)) < 0.05
+
+
+def test_fp8_kv_capacity_doubles():
+    """The capacity contract: fp8 pools hold the same tokens in less
+    than half the bytes, so a fixed byte budget fits 2x the resident
+    requests (kv_bytes_per_token is the gauge the engine exports)."""
+    hd = D // H
+    f32_pools = kvcache.make_pools(NL, 16, 4, H, hd)
+    fp8_pools = kvcache.make_pools(NL, 16, 4, H, hd, quant="fp8")
+    assert 2 * kvcache.pool_nbytes(*fp8_pools) <= \
+        kvcache.pool_nbytes(*f32_pools)
+    assert 2 * kvcache.kv_bytes_per_token(NL, H, hd, "fp8") <= \
+        kvcache.kv_bytes_per_token(NL, H, hd)
+
+
+def test_attn_impl_parity():
+    """The decode-attention impl knob is numerics-neutral: the one-shot
+    dense gather and the interpret-mode flash kernel match the
+    reference block scan on the same paged pools."""
+    q, kd, vd, kp, vp, tables, lengths, BS = _paged_setup()
+    args = (jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+            jnp.asarray(tables), jnp.asarray(lengths))
+    scan = np.asarray(kvcache.paged_attention(*args, impl="scan"))
+    dense = np.asarray(kvcache.paged_attention(*args, impl="dense"))
+    flash = np.asarray(kvcache.paged_attention(*args,
+                                               impl="flash_interpret"))
+    np.testing.assert_allclose(dense, scan, rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(flash, scan, rtol=1e-5, atol=1e-6)
+    with pytest.raises(MXNetError):
+        kvcache.paged_attention(*args, impl="nope")
+
+
+def test_scheduler_prefill_backlog_discounts_slack():
+    """The r12 scheduler fix: SLO at-risk slack must account for the
+    prefill-chunk backlog of already-active requests — wait the queued
+    request will certainly absorb before its first token."""
+    s = Scheduler(max_batch=2, slo_admit_frac=0.5)
+    early = s.submit(Request(prompt=[1]), now=0.0)       # FIFO head
+    slo = s.submit(Request(prompt=[2], slo_ms=100.0), now=0.0)
+    # 30 ms waited: under the 50 ms jump threshold on its own...
+    assert s.admission_order(now=0.030)[0] is early
+    # ...but a 25 ms chunk backlog pushes it over -> SLO jump
+    assert s.admission_order(now=0.030,
+                             prefill_backlog_ms=25.0)[0] is slo
+    # admit() honors the same discounted order
+    got = s.admit(lambda r: True, now=0.030, prefill_backlog_ms=25.0)
+    assert got[0] is slo
+
+
+def test_engine_prefill_backlog_estimate():
+    """The engine's backlog estimate counts remaining chunks of
+    mid-prefill requests only, scaled by the EWMA chunk latency."""
+    eng = _engine(prefill_chunk=4)
+    assert eng._prefill_backlog_ms() == 0.0        # no history, no work
+    eng._chunk_ms = 2.0                            # pretend EWMA history
+    r = Request(prompt=list(range(9)))
+    r.prefilled, r.prefill_target = 1, 9           # ceil(8/4) = 2 chunks
+    eng.sched.running.append(r)
+    assert eng._prefill_backlog_ms() == pytest.approx(4.0)
+    r.prefilled = 9                                # drained -> no backlog
+    assert eng._prefill_backlog_ms() == 0.0
+
+
+def test_chunked_prefill_telemetry():
+    """Round-12 telemetry: the chunk counter ticks once per chunk and
+    the kv_bytes_per_token gauge is fp8-aware."""
+    eng = _engine(prefill_chunk=4, kv_quant="fp8")
+    rid = eng.submit(list(range(1, 11)), max_new_tokens=4)
+    eng.result(rid)
+    flat = telemetry.snapshot_flat()
+    assert flat.get("serve.prefill_chunks", 0) >= 3   # ceil(10 / 4)
+    assert flat.get("kv_bytes_per_token") == \
+        kvcache.kv_bytes_per_token(NL, H, D // H, "fp8")
+    assert flat.get("serve.prefills", 0) >= 1         # completion ticks
+
+
+def test_engine_config_validation_round12():
+    with pytest.raises(MXNetError):
+        _engine(attn_impl="nope")
+    with pytest.raises(MXNetError):
+        _engine(kv_quant="int4")
+    with pytest.raises(MXNetError):
+        _engine(prefill_chunk=-1)
+    assert _engine(attn_impl="auto").attn_impl == "dense"  # CPU resolve

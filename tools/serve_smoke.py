@@ -1,7 +1,8 @@
 #!/usr/bin/env python
 """CI smoke for the serving tier (docs/serving.md).
 
-Builds a tiny transformer-LM, warms a continuous-batching engine
+Builds a tiny transformer-LM, warms a continuous-batching engine —
+round-12 config: chunked prefill + fp8-quantized paged KV pools —
 through the compile cache, then pushes 8 concurrent streams through it
 and asserts:
 
@@ -12,7 +13,9 @@ and asserts:
    programs (the retrace guard the serving tier lives or dies by);
 3. serve telemetry is live: the exported Perfetto trace validates and
    carries the serve.prefill / serve.decode / serve.admit spans, and
-   the metrics registry holds the serve.tokens_total counter.
+   the metrics registry holds the serve.tokens_total counter, the
+   serve.prefill_chunks counter (every prompt ingested through the
+   chunk pump), and the fp8-aware kv_bytes_per_token gauge.
 
 Exit 0 on success, 1 with a reason on any failure.  Runs on the CPU
 mesh in a few seconds; invoked by tools/ci_check.sh after the
@@ -63,15 +66,17 @@ def main() -> None:
 
     eng = Engine(params, EngineConfig(
         heads=H, block_size=4, num_blocks=64, max_batch=8,
-        max_prompt_len=16, max_seq_len=48, prompt_bucket_min=8))
+        max_prompt_len=16, max_seq_len=48, prompt_bucket_min=8,
+        prefill_chunk=8, kv_quant="fp8"))
     eng.warmup()
 
     r = np.random.RandomState(1)
     budgets = [int(r.randint(6, 13)) for _ in range(8)]
-    ids = [eng.submit(list(map(int, r.randint(1, V, int(r.randint(2, 9))))),
-                      max_new_tokens=m, temperature=0.8 * (i % 2),
+    prompts = [list(map(int, r.randint(1, V, int(r.randint(2, 9)))))
+               for _ in budgets]
+    ids = [eng.submit(p, max_new_tokens=m, temperature=0.8 * (i % 2),
                       seed=i)
-           for i, m in enumerate(budgets)]
+           for i, (p, m) in enumerate(zip(prompts, budgets))]
 
     # 1 step = admit all 8 + prefill + first batched decode.  The engine
     # must already be warm here: zero traces from step 1 onward.
@@ -100,6 +105,16 @@ def main() -> None:
     if flat.get("serve.tokens_total") != want:
         fail(f"serve.tokens_total={flat.get('serve.tokens_total')} "
              f"!= {want} tokens generated")
+    min_chunks = sum(-(-len(p) // eng.prefill_chunk) for p in prompts)
+    chunks = flat.get("serve.prefill_chunks", 0)
+    if chunks < min_chunks:
+        fail(f"serve.prefill_chunks={chunks} < {min_chunks} (every "
+             "prompt must ingest through the chunk pump)")
+    from mxnet_tpu.serve import kvcache
+    want_bpt = kvcache.kv_bytes_per_token(NL, H, D // H, "fp8")
+    if flat.get("kv_bytes_per_token") != want_bpt:
+        fail(f"kv_bytes_per_token gauge {flat.get('kv_bytes_per_token')}"
+             f" != {want_bpt} (fp8 pool accounting)")
 
     path = telemetry.export_trace()
     info = telemetry.validate_trace(path)
@@ -111,9 +126,10 @@ def main() -> None:
              f"(have {sorted(info['span_names'])})")
 
     print(f"serve_smoke: OK (8 streams, {want} tokens, "
-          f"{eng.step_idx} steps, traces {sum(traces_warm.values())} "
-          f"at warmup + 0 after, {info['events']} trace events, "
-          f"dir={tmp})")
+          f"{eng.step_idx} steps, {int(chunks)} prefill chunks, "
+          f"fp8 kv {want_bpt} B/token, traces "
+          f"{sum(traces_warm.values())} at warmup + 0 after, "
+          f"{info['events']} trace events, dir={tmp})")
 
 
 if __name__ == "__main__":
