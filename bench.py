@@ -892,6 +892,15 @@ def bench_serve(args):
     informational config (no r10 twin to diff against).  Results land in
     ``BENCH_r11.json``; ``tools/parse_log.py --diff-serve`` diffs two of
     these reports (tokens/s, p99 token, p99 TTFT gates).
+
+    With ``--chaos`` (ISSUE 12) a failover scenario rides along and the
+    report lands in ``BENCH_r12.json`` instead: a 2-replica router runs
+    the same mix twice — clean, then with a ``serve_crash`` chaos point
+    killing replica 0 mid-decode — and the row records recovery
+    latency, tokens lost (must be 0), stream byte-identity vs the clean
+    run, and that the survivor ran zero post-warmup retraces.
+    ``parse_log.py --diff-serve`` gates that the chaos row completed
+    every request.
     """
     import jax
     from mxnet_tpu.models.transformer import transformer_lm
@@ -1017,8 +1026,69 @@ def bench_serve(args):
         "n_devices": len(jax.devices()),
     })
     _emit_row(rows[-1])
+    if getattr(args, "chaos", False):
+        from mxnet_tpu.chaos import ChaosSpec
+        from mxnet_tpu.serve import Router, RouterConfig
+        cfg = EngineConfig(heads=H, block_size=16, num_blocks=256,
+                           max_batch=4, max_queue=max(64, n_req),
+                           max_prompt_len=64, max_seq_len=128,
+                           prompt_bucket_min=16)
+        rcfg = RouterConfig(replicas=2)
+
+        def fleet(chaos):
+            router = Router(params, cfg, rcfg, chaos=chaos)
+            router.warmup()
+            warm = [dict(rep.engine.trace_counts)
+                    for rep in router.replicas]
+            t0 = time.perf_counter()
+            ids = [router.submit(p, max_new_tokens=m, seed=i)
+                   for i, (p, m) in enumerate(reqs)]
+            router.run()
+            return router, ids, warm, time.perf_counter() - t0
+
+        ref_router, ref_ids, _, _ = fleet({})
+        ref = [ref_router.request(i).tokens for i in ref_ids]
+        crash_step = max(4, new_tok // 2)  # mid-decode, streams in flight
+        router, ids, warm, wall = fleet(
+            {0: ChaosSpec({"serve_crash": {crash_step}})})
+        got = [router.request(i).tokens for i in ids]
+        completed = sum(1 for i in ids
+                        if router.request(i).state == "finished")
+        tokens_lost = sum(max(0, len(a) - len(b))
+                          for a, b in zip(ref, got))
+        survivor_traces = sum(
+            sum(dict(rep.engine.trace_counts).values())
+            - sum(warm[rep.idx].values())
+            for rep in router.replicas if rep.state == "healthy")
+        rec = router.recoveries_ms
+        failovers = router.stats()["failovers"]
+        rows.append({
+            "metric": f"serve chaos failover (replica crash @ step "
+                      f"{crash_step}, {n_req} reqs x {new_tok} new "
+                      f"tokens, 2 replicas, {dev})",
+            "value": round(float(np.median(rec)), 2) if rec else 0.0,
+            "unit": "ms median failover recovery",
+            "vs_baseline": None,
+            "completed": completed,
+            "total": len(ids),
+            "tokens_lost": tokens_lost,
+            "streams_identical": bool(got == ref),
+            "failovers": failovers,
+            "recovery_ms_max": round(max(rec), 2) if rec else 0.0,
+            "survivor_traces_after_warmup": survivor_traces,
+            "wall_s": round(wall, 2),
+            "target": "all requests complete, 0 tokens lost, streams "
+                      "byte-identical to the no-failure run, zero "
+                      "survivor retraces",
+            "pass": bool(completed == len(ids) and tokens_lost == 0
+                         and got == ref and failovers >= 1
+                         and survivor_traces == 0),
+            "n_devices": len(jax.devices()),
+        })
+        _emit_row(rows[-1])
     out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                       "BENCH_r11.json")
+                       "BENCH_r12.json" if getattr(args, "chaos", False)
+                       else "BENCH_r11.json")
     with open(out, "w") as f:
         json.dump(rows, f, indent=2)
         f.write("\n")
@@ -1289,6 +1359,11 @@ def main():
                     help="--serve: number of requests in the load mix")
     ap.add_argument("--serve-tokens", type=_positive, default=32,
                     help="--serve: new tokens generated per request")
+    ap.add_argument("--chaos", action="store_true",
+                    help="--serve: add the router failover scenario "
+                    "(chaos-killed replica mid-decode; recovery "
+                    "latency, tokens lost must be 0, streams "
+                    "byte-identical) -> BENCH_r12.json")
     args = ap.parse_args()
     if args.compute_dtype == "none":
         args.compute_dtype = None

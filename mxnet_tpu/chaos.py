@@ -17,6 +17,16 @@ Kinds: ``nan`` (NaN-filled data), ``overflow`` (1e30-filled data),
 *global* batch counts over the iterator's lifetime — they survive
 ``reset()`` so an injection fires exactly once even across epochs.
 
+Serve-side kinds (consumed by :class:`mxnet_tpu.serve.engine.Engine`
+at exact ``step_idx`` values, not by :class:`ChaosIter`):
+``serve_crash`` (the replica's step raises :class:`ChaosError` —
+process death), ``serve_hang`` (the step wedges permanently: no
+progress, no heartbeat — only the router's timeout gets the requests
+out), ``serve_poison_logits`` (one step runs on NaN-poisoned weights;
+the engine's in-graph finite guard must catch it).  With multiple
+router replicas, ``MXNET_TPU_CHAOS_REPLICA`` picks which replica the
+spec applies to (default 0).
+
 ``flip_byte`` / ``corrupt_record`` corrupt RecordIO pack files on disk
 for the tolerant-reader tests.
 """
@@ -32,6 +42,7 @@ import numpy as np
 _LOGGER = logging.getLogger(__name__)
 
 KINDS = ("nan", "overflow", "crash")
+SERVE_KINDS = ("serve_crash", "serve_hang", "serve_poison_logits")
 
 OVERFLOW_VALUE = 1e30  # squares past f32 max, flushes f16/bf16 to inf
 
@@ -43,9 +54,9 @@ class ChaosError(RuntimeError):
 class ChaosSpec(object):
     def __init__(self, points: Dict[str, Set[int]]):
         for kind in points:
-            if kind not in KINDS:
+            if kind not in KINDS + SERVE_KINDS:
                 raise ValueError("unknown chaos kind %r (know %s)"
-                                 % (kind, ", ".join(KINDS)))
+                                 % (kind, ", ".join(KINDS + SERVE_KINDS)))
         self.points = {k: set(v) for k, v in points.items() if v}
 
     def __bool__(self) -> bool:
@@ -77,6 +88,24 @@ def from_env() -> Optional[ChaosSpec]:
         return None
     spec = ChaosSpec.parse(raw)
     return spec if spec else None
+
+
+def serve_from_env() -> Optional[ChaosSpec]:
+    """The serve-side slice of ``MXNET_TPU_CHAOS`` (``serve_*`` kinds
+    only), or ``None``.  Data kinds stay with :class:`ChaosIter`; a
+    mixed spec feeds both consumers without either seeing the other's
+    points."""
+    spec = from_env()
+    if spec is None:
+        return None
+    points = {k: v for k, v in spec.points.items() if k in SERVE_KINDS}
+    return ChaosSpec(points) if points else None
+
+
+def chaos_replica() -> int:
+    """Which router replica ``MXNET_TPU_CHAOS`` targets (default 0)."""
+    raw = os.environ.get("MXNET_TPU_CHAOS_REPLICA", "").strip()
+    return int(raw) if raw else 0
 
 
 def _poison_array(arr, value: float):

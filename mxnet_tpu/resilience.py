@@ -45,6 +45,7 @@ import collections
 import logging
 import os
 import statistics
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -461,3 +462,57 @@ def legacy_guard_for(optimizer, logger=None) -> Optional[LegacyGuard]:
                        rescale_grad=getattr(optimizer, "rescale_grad",
                                             1.0),
                        logger=logger)
+
+
+class Heartbeat(object):
+    """Progress-based liveness tracking for a set of named peers.
+
+    The in-process analog of :mod:`mxnet_tpu.parallel.watchdog`'s
+    socket heartbeat, shared by the serving router: a peer is healthy
+    while its *progress counter advances*, stale once ``timeout_ms``
+    passes without an advance.  Merely calling into a peer and
+    returning is not proof of life — a wedged replica's ``step()`` can
+    return instantly having done nothing, which is exactly the failure
+    this must catch.
+
+    ``clock`` is injectable so timeout tests advance a fake clock
+    instead of sleeping."""
+
+    def __init__(self, timeout_ms: float, clock=time.monotonic):
+        self.timeout_ms = float(timeout_ms)
+        self._clock = clock
+        self._last: Dict[Any, float] = {}
+        self._progress: Dict[Any, Any] = {}
+
+    def beat(self, peer, progress=None, now: Optional[float] = None) -> bool:
+        """Record a liveness observation.  With ``progress`` given, the
+        beat only registers when the counter moved since the last
+        observation; without it, the call itself counts (use for peers
+        that are legitimately idle).  Returns whether the beat
+        registered."""
+        now = self._clock() if now is None else now
+        known = peer in self._last
+        if (known and progress is not None
+                and progress == self._progress.get(peer)):
+            return False
+        self._last[peer] = now
+        self._progress[peer] = progress
+        return True
+
+    def age_ms(self, peer, now: Optional[float] = None) -> float:
+        """Milliseconds since the peer's last registered beat (0 for a
+        never-seen peer: unknown is not the same as dead)."""
+        now = self._clock() if now is None else now
+        return (now - self._last.get(peer, now)) * 1e3
+
+    def stale(self, now: Optional[float] = None) -> List[Any]:
+        """Peers whose last registered beat is older than
+        ``timeout_ms``."""
+        now = self._clock() if now is None else now
+        return [p for p, t in sorted(self._last.items())
+                if (now - t) * 1e3 > self.timeout_ms]
+
+    def forget(self, peer) -> None:
+        """Stop tracking a peer (declared dead or drained)."""
+        self._last.pop(peer, None)
+        self._progress.pop(peer, None)

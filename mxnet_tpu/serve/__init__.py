@@ -15,11 +15,16 @@ in, concurrent token streams come out.
   prefill/decode programs AOT-warmed through
   :mod:`~mxnet_tpu.compile_cache`, weights from ``checkpoint/``
   manifests or legacy ``.params``.
+* :mod:`~mxnet_tpu.serve.router` — the control plane: N engine
+  replicas behind heartbeat health checks, mid-stream failover,
+  per-request deadlines, SLO-aware load shedding, graceful drain.
 """
-from . import engine, kvcache, scheduler
+from . import engine, kvcache, router, scheduler
 from .engine import Engine, EngineConfig
 from .kvcache import BlockAllocator
-from .scheduler import Request, Scheduler
+from .router import Router, RouterConfig
+from .scheduler import Request, Scheduler, ServeError
 
 __all__ = ["Engine", "EngineConfig", "BlockAllocator", "Request",
-           "Scheduler", "engine", "kvcache", "scheduler"]
+           "Router", "RouterConfig", "Scheduler", "ServeError",
+           "engine", "kvcache", "router", "scheduler"]

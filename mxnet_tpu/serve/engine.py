@@ -46,6 +46,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import chaos as chaos_mod
 from .. import compile_cache as cc
 from .. import telemetry
 from ..base import MXNetError
@@ -53,9 +54,10 @@ from ..models.transformer import (lm_config_from_params,
                                   transformer_lm_decode,
                                   transformer_lm_prefill)
 from . import kvcache
-from .scheduler import CANCELLED, FINISHED, Request, Scheduler
+from .scheduler import (CANCELLED, FAILED, FINISHED, Request, Scheduler,
+                        ServeError)
 
-__all__ = ["EngineConfig", "Engine"]
+__all__ = ["EngineConfig", "Engine", "ServeError"]
 
 _NEG = -1e30
 
@@ -92,6 +94,7 @@ class EngineConfig:
     prompt_bucket_factor: float = 2.0
     slo_ms: Optional[float] = None       # default per-request SLO
     slo_admit_frac: float = 0.5
+    deadline_ms: Optional[float] = None  # default per-request hard wall
     seed: int = 0
     dtype: Any = jnp.float32
     # -- round-12 tail-latency knobs (docs/serving.md) --
@@ -112,6 +115,7 @@ class EngineConfig:
             max_queue=_env_int("MXNET_TPU_SERVE_MAX_QUEUE", 64),
             max_seq_len=_env_int("MXNET_TPU_SERVE_MAX_SEQ", 256),
             slo_ms=_env_float("MXNET_TPU_SERVE_SLO_MS", None),
+            deadline_ms=_env_float("MXNET_TPU_SERVE_DEADLINE_MS", None),
             prefill_chunk=_env_int("MXNET_TPU_SERVE_PREFILL_CHUNK", 0),
             kv_quant=(os.environ.get("MXNET_TPU_SERVE_KV_QUANT", "")
                       .strip().lower() or None),
@@ -189,8 +193,19 @@ class Engine:
     """Continuous-batching autoregressive server for ``transformer_lm``
     parameter dicts.  See the module docstring for the step anatomy."""
 
-    def __init__(self, params: Dict[str, Any], config: EngineConfig):
+    def __init__(self, params: Dict[str, Any], config: EngineConfig,
+                 chaos: Optional[chaos_mod.ChaosSpec] = None):
         self.config = config
+        # chaos=None reads MXNET_TPU_CHAOS (serve_* kinds); pass an
+        # empty ChaosSpec to force chaos off (the router does, for
+        # replicas the spec does not target)
+        if chaos is None:
+            chaos = chaos_mod.serve_from_env()
+        self.chaos = chaos if chaos else None
+        self.beat = 0            # liveness: +1 per COMPLETED step
+        self._hung = False       # chaos serve_hang: steps become no-ops
+        self._poison_step = False
+        self._poison_params = None
         self._params = {k: jnp.asarray(
             v.asnumpy() if hasattr(v, "asnumpy") else v)
             for k, v in params.items()}
@@ -243,8 +258,10 @@ class Engine:
         self.requests: Dict[int, Request] = {}
         self.step_idx = 0
         self._chunk_ms = 0.0   # EWMA chunk-prefill latency (SLO backlog)
+        # "serve2": program outputs grew a finite-logits guard flag —
+        # old cached executables have the wrong output arity
         self._fingerprint = (
-            f"serve:{self.vocab}:{self.num_layers}:{self.d_model}:"
+            f"serve2:{self.vocab}:{self.num_layers}:{self.d_model}:"
             f"{self.heads}:bs{bs}:nb{config.num_blocks}:"
             f"mb{self.max_blocks}:{np.dtype(config.dtype).name}:"
             f"pc{self.prefill_chunk}:kv{config.kv_quant or 'f32'}:"
@@ -284,7 +301,8 @@ class Engine:
                                               table_row, length)
             last = jnp.take(logits[0], length - 1, axis=0)
             tok = _sample_row(last, key, temp, topk, length)
-            return kpool, vpool, tok
+            ok = jnp.all(jnp.isfinite(last.astype(jnp.float32)))
+            return kpool, vpool, tok, ok
 
         return fn
 
@@ -324,7 +342,8 @@ class Engine:
             last = jnp.take(logits[0],
                             jnp.clip(length - 1 - start, 0, cb - 1), axis=0)
             tok = _sample_row(last, key, temp, topk, length)
-            return pools[0], pools[1], tok
+            ok = jnp.all(jnp.isfinite(last.astype(jnp.float32)))
+            return pools[0], pools[1], tok, ok
 
         return fn
 
@@ -349,7 +368,8 @@ class Engine:
             logits = transformer_lm_decode(params, tokens, heads=heads,
                                            attend=attend)
             toks = _sample_batch(logits, keys, temps, topks, lengths + 1)
-            return pools[0], pools[1], toks
+            oks = jnp.all(jnp.isfinite(logits.astype(jnp.float32)), axis=-1)
+            return pools[0], pools[1], toks, oks
 
         return fn
 
@@ -414,7 +434,8 @@ class Engine:
                temperature: float = 0.0, top_k: int = 0,
                slo_ms: Optional[float] = None,
                eos_id: Optional[int] = None,
-               seed: Optional[int] = None) -> int:
+               seed: Optional[int] = None,
+               deadline_ms: Optional[float] = None) -> int:
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise MXNetError("empty prompt")
@@ -429,6 +450,8 @@ class Engine:
         req = Request(prompt=prompt, max_new_tokens=int(max_new_tokens),
                       temperature=float(temperature), top_k=int(top_k),
                       slo_ms=slo_ms, eos_id=eos_id)
+        req.deadline_ms = (deadline_ms if deadline_ms is not None
+                           else self.config.deadline_ms)
         # the sampling key is (engine seed, request seed, position)-pure:
         # an explicit `seed` replays the same stream in any engine,
         # regardless of admission order or batch composition
@@ -438,6 +461,52 @@ class Engine:
         self.sched.submit(req)
         self.requests[req.id] = req
         telemetry.counter("serve.submitted").inc()
+        return req.id
+
+    def adopt(self, prompt: Sequence[int], tokens: Sequence[int], *,
+              max_new_tokens: int = 32, temperature: float = 0.0,
+              top_k: int = 0, slo_ms: Optional[float] = None,
+              eos_id: Optional[int] = None, seed: Optional[int] = None,
+              deadline_ms: Optional[float] = None,
+              submit_t: Optional[float] = None) -> int:
+        """Admit a request that already produced ``tokens`` on another
+        engine — the router's mid-stream failover path.  The request
+        re-prefills ``prompt + tokens`` (the standard preemption
+        mechanics) and, because sampling keys are (seed, position)-pure,
+        continues the exact token stream the dead replica would have
+        produced.  ``seed`` is mandatory: the implicit seed (this
+        engine's request id) could never match the original's.
+        ``submit_t`` carries the original submit time so SLO and
+        deadline clocks keep running across the failure."""
+        prompt = [int(t) for t in prompt]
+        tokens = [int(t) for t in tokens]
+        if seed is None:
+            raise MXNetError("adopt() needs the original request seed")
+        if not prompt:
+            raise MXNetError("empty prompt")
+        if len(prompt) + max_new_tokens > self.config.max_seq_len:
+            raise MXNetError(
+                f"prompt {len(prompt)} + max_new_tokens {max_new_tokens} "
+                f"exceeds max_seq_len {self.config.max_seq_len}")
+        if len(tokens) >= max_new_tokens:
+            raise MXNetError(
+                f"nothing to adopt: {len(tokens)} tokens already meet "
+                f"max_new_tokens {max_new_tokens}")
+        req = Request(prompt=prompt, max_new_tokens=int(max_new_tokens),
+                      temperature=float(temperature), top_k=int(top_k),
+                      slo_ms=slo_ms, eos_id=eos_id)
+        req.deadline_ms = (deadline_ms if deadline_ms is not None
+                           else self.config.deadline_ms)
+        req.tokens = list(tokens)
+        req.key = np.asarray(jax.random.fold_in(
+            self._base_key, int(seed)), np.uint32)
+        self.sched.submit(req, now=submit_t)
+        if tokens:
+            # first token already streamed elsewhere — don't re-record
+            # TTFT for the continuation
+            req.first_token_t = req.submit_t
+        self.requests[req.id] = req
+        telemetry.counter("serve.adopted").inc()
         return req.id
 
     def cancel(self, req_id: int) -> None:
@@ -457,7 +526,10 @@ class Engine:
 
     def stream(self, req_id: int):
         """Generator of token ids as they are produced; drives the
-        engine loop while the request is live."""
+        engine loop while the request is live.  A request that fails
+        (timeout, NaN logits, shed) raises :class:`ServeError` after
+        any tokens produced so far — mid-stream failure surfaces as a
+        typed exception, never silently truncated output."""
         req = self._req(req_id)
         cursor = 0
         while True:
@@ -465,12 +537,15 @@ class Engine:
                 yield req.tokens[cursor]
                 cursor += 1
             if req.done():
+                if req.state == FAILED:
+                    raise ServeError(req.finish_reason or "error", req_id)
                 return
             self.step()
 
     def result(self, req_id: int) -> List[int]:
         """Run the engine until the request completes; returns its
-        generated tokens."""
+        generated tokens.  Raises :class:`ServeError` (with the finish
+        reason) if the request failed."""
         req = self._req(req_id)
         guard = 0
         while not req.done():
@@ -478,6 +553,8 @@ class Engine:
             guard += 1
             if guard > 10 * self.config.max_seq_len + 100:
                 raise MXNetError(f"request {req_id} failed to converge")
+        if req.state == FAILED:
+            raise ServeError(req.finish_reason or "error", req_id)
         return list(req.tokens)
 
     def run(self, max_steps: int = 100000) -> None:
@@ -504,11 +581,26 @@ class Engine:
             raise
 
     def _step_inner(self) -> None:
+        if self._hung:
+            # a wedged device step: returns nothing, makes no progress,
+            # never advances `beat` — the router's heartbeat timeout is
+            # the only way its requests get out
+            return
         self.step_idx += 1
+        self._poison_step = False
+        if self.chaos is not None:
+            self._chaos_fire()
+            if self._hung:
+                return
         now = time.monotonic()
         for req in list(self.sched.running):
             if req.cancel_requested:
                 self._finish(req, "cancelled", CANCELLED)
+        for req in list(self.sched.running) + list(self.sched.queue):
+            if (req.deadline_ms is not None
+                    and (now - req.submit_t) * 1e3 > req.deadline_ms):
+                telemetry.counter("serve.timeouts").inc()
+                self._finish(req, "timeout", FAILED)
         with telemetry.span("serve.admit", step=self.step_idx,
                             queued=self.sched.queue_depth):
             admitted = self.sched.admit(
@@ -530,6 +622,52 @@ class Engine:
             "kind": "serve", "step": self.step_idx,
             "active": self.sched.active, "queued": self.sched.queue_depth,
             "blocks_used": self.alloc.num_used})
+        self.beat += 1
+
+    def _chaos_fire(self) -> None:
+        """Serve-side chaos points, fired by exact step index (global
+        over the engine's lifetime, so failures reproduce bit-for-bit)."""
+        i = self.step_idx
+        if self.chaos.at("serve_crash", i):
+            telemetry.counter("serve.chaos_injected").inc(kind="crash")
+            raise chaos_mod.ChaosError(
+                "chaos: injected replica crash at serve step %d" % i)
+        if self.chaos.at("serve_hang", i):
+            telemetry.counter("serve.chaos_injected").inc(kind="hang")
+            self._hung = True
+            return
+        if self.chaos.at("serve_poison_logits", i):
+            telemetry.counter("serve.chaos_injected").inc(kind="poison")
+            self._poison_step = True
+
+    def _step_params(self):
+        """Model weights for this step — NaN-poisoned under the
+        ``serve_poison_logits`` chaos point (same shapes/dtypes, so the
+        same compiled program runs; the in-graph finite guard must be
+        what catches it, not a shape error)."""
+        if not self._poison_step:
+            return self._params
+        if self._poison_params is None:
+            self._poison_params = {
+                k: (jnp.full_like(v, jnp.nan)
+                    if jnp.issubdtype(v.dtype, jnp.floating) else v)
+                for k, v in self._params.items()}
+        return self._poison_params
+
+    def _fail_nan(self, req: Request) -> None:
+        telemetry.counter("serve.nan_logits").inc()
+        telemetry.flight_recorder().record({
+            "kind": "serve.nan_logits", "req": req.id,
+            "step": self.step_idx})
+        # the request's cached K/V (and the trash block, which padding
+        # rows wrote this step) may hold NaN — scrub before the blocks
+        # go back to the pool, or the residue leaks into the next
+        # request that reuses them (masked attention lanes multiply by
+        # zero, and 0 * NaN = NaN)
+        scrub = list(req.blocks) + [kvcache.TRASH_BLOCK]
+        self.kpool = kvcache.scrub_blocks(self.kpool, scrub)
+        self.vpool = kvcache.scrub_blocks(self.vpool, scrub)
+        self._finish(req, "error", FAILED)
 
     def _admission_gate(self):
         """``can_place`` for one admit pass.  Blocks promised to earlier
@@ -563,15 +701,19 @@ class Engine:
         t0 = time.monotonic()
         with telemetry.span("serve.prefill", req=req.id, bucket=lb,
                             prompt=plen):
-            self.kpool, self.vpool, tok = self._programs[("prefill", lb)](
-                self.kpool, self.vpool, self._params, padded,
-                np.int32(plen), table_row, req.key,
-                np.float32(req.temperature), np.int32(req.top_k))
+            self.kpool, self.vpool, tok, ok = (
+                self._programs[("prefill", lb)](
+                    self.kpool, self.vpool, self._step_params(), padded,
+                    np.int32(plen), table_row, req.key,
+                    np.float32(req.temperature), np.int32(req.top_k)))
         req.cached = plen
         req.prefilled = req.prefill_target = plen
         telemetry.counter("serve.prefills").inc()
         telemetry.histogram("serve.prefill_ms").observe(
             (time.monotonic() - t0) * 1e3)
+        if not bool(ok):
+            self._fail_nan(req)
+            return
         self._append_token(req, int(tok))
 
     # -- chunked prefill (round 12) ---------------------------------------
@@ -625,9 +767,9 @@ class Engine:
         with telemetry.span("serve.prefill", req=req.id, bucket=cb,
                             prompt=plen, chunk_start=start,
                             chunk_budget=cb):
-            self.kpool, self.vpool, tok = (
+            self.kpool, self.vpool, tok, ok = (
                 self._programs[("prefill_chunk", cb)](
-                    self.kpool, self.vpool, self._params, padded,
+                    self.kpool, self.vpool, self._step_params(), padded,
                     np.int32(start), np.int32(plen), table_row, req.key,
                     np.float32(req.temperature), np.int32(req.top_k)))
         ms = (time.monotonic() - t0) * 1e3
@@ -637,6 +779,11 @@ class Engine:
         req.cached = req.prefilled
         telemetry.counter("serve.prefill_chunks").inc()
         telemetry.histogram("serve.prefill_ms").observe(ms)
+        if not bool(ok):
+            # mid-chunk NaN already contaminated this request's cached
+            # K/V — fail now rather than stream garbage at the end
+            self._fail_nan(req)
+            return
         if req.prefilled >= plen:
             telemetry.counter("serve.prefills").inc()
             self._append_token(req, int(tok))
@@ -721,14 +868,20 @@ class Engine:
         t0 = time.monotonic()
         with telemetry.span("serve.decode", step=self.step_idx, bucket=bb,
                             active=len(active)):
-            self.kpool, self.vpool, toks = self._programs[("decode", bb)](
-                self.kpool, self.vpool, self._params, tokens, tables,
-                lengths, slots, offsets, active_m, keys, temps, topks)
+            self.kpool, self.vpool, toks, oks = (
+                self._programs[("decode", bb)](
+                    self.kpool, self.vpool, self._step_params(), tokens,
+                    tables, lengths, slots, offsets, active_m, keys,
+                    temps, topks))
         toks = np.asarray(toks)
+        oks = np.asarray(oks)
         step_ms = (time.monotonic() - t0) * 1e3
         hist = telemetry.histogram("serve.token_ms")
         for i, req in enumerate(active):
             req.cached += 1
+            if not bool(oks[i]):
+                self._fail_nan(req)
+                continue
             hist.observe(step_ms)
             self._append_token(req, int(toks[i]))
 
@@ -782,6 +935,9 @@ class Engine:
             "active": self.sched.active,
             "queued": self.sched.queue_depth,
             "steps": self.step_idx,
+            "beat": self.beat,
+            "hung": self._hung,
+            "chaos": bool(self.chaos),
             "prompt_buckets": list(self.prompt_buckets),
             "decode_buckets": list(self.decode_buckets),
             "prefill_chunk": self.prefill_chunk,

@@ -482,6 +482,19 @@ def write_decode(pool, layer: int, states, slots, offsets, active):
     return pool.at[layer, slot, offsets].set(states)
 
 
+def scrub_blocks(pool, blocks):
+    """Zero the given physical blocks (payload and scales).  Called
+    when a request's cached K/V may be non-finite (NaN-poisoned step,
+    caught by the engine's finite guard): blocks must return to the
+    free pool finite, because attention masks invalid lanes by
+    *multiplying by zero* — and ``0 * NaN`` is NaN, so a non-finite
+    residue would leak into whichever request reuses the block."""
+    if not blocks:
+        return pool
+    idx = jnp.asarray(sorted(set(int(b) for b in blocks)), jnp.int32)
+    return jax.tree_util.tree_map(lambda a: a.at[:, idx].set(0), pool)
+
+
 def compact_pool(pool, mapping: Dict[int, int]):
     """Apply a :meth:`BlockAllocator.defrag` relocation map to a pool:
     copy each moved slot's contents to its new physical index.  Values

@@ -1,0 +1,502 @@
+"""Fault-tolerant serving control plane: N Engine replicas behind one
+front door.
+
+The router owns a fleet of :class:`~mxnet_tpu.serve.engine.Engine`
+replicas (in-process handles today; the handle surface — submit /
+adopt / step / beat — is what a process-backed replica via
+``parallel/launch.py`` would expose over a pipe).  Per step it:
+
+1. steps every live replica, catching a crashed step (the replica is
+   declared **dead**, cause ``crash``),
+2. registers a heartbeat per replica — *progress-based*
+   (:class:`~mxnet_tpu.resilience.Heartbeat`): a replica whose ``beat``
+   counter stopped advancing is wedged even though ``step()`` returns,
+   and past ``heartbeat_timeout_ms`` it is declared dead (cause
+   ``heartbeat``),
+3. syncs every in-flight request's tokens into the router's own
+   buffer — the only state failover may rely on; a dead replica's
+   memory is gone —
+4. and retires replicas whose drain completed.
+
+**Mid-stream failover**: when a replica dies, each of its live
+requests is re-submitted to a survivor via ``Engine.adopt(prompt,
+tokens_so_far)``.  The survivor re-prefills ``prompt + tokens_so_far``
+(the standard preemption mechanics) and, because sampling keys are
+(seed, position)-pure, continues the *exact* token stream the dead
+replica would have produced — the client-visible sequence is
+byte-identical to a run with no failure (pinned by
+``tests/test_serve_router.py``).  The router therefore always assigns
+the per-request sampling seed itself: engine-implicit seeds (request
+ids) could never match across replicas.
+
+**Load shedding** is decided at the front door, per submit, against
+the least-loaded healthy replica: hard queue-depth / KV-pressure
+thresholds (``shed_queue_depth``, ``shed_kv_frac``) plus an SLO-aware
+estimate (queued work x recent step latency already over the request's
+``slo_ms``).  A shed request fails fast with reason ``"shed"`` —
+``result()``/``stream()`` raise :class:`ServeError` — instead of
+queueing toward a deadline it cannot meet.
+
+Every death, failover, shed, and timeout lands in the telemetry
+registry (``serve.router.*``, ``serve.shed``, ``serve.timeouts``) and
+the flight recorder (``serve-replica-death`` dumps).  Failure
+injection comes from :mod:`mxnet_tpu.chaos` serve points
+(``serve_crash`` / ``serve_hang`` / ``serve_poison_logits``),
+targeted at one replica via ``MXNET_TPU_CHAOS_REPLICA``.
+"""
+from __future__ import annotations
+
+import itertools
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence
+
+from .. import chaos as chaos_mod
+from .. import telemetry
+from ..base import MXNetError
+from ..resilience import Heartbeat
+from .engine import Engine, EngineConfig, _env_float, _env_int
+from .scheduler import ACTIVE, CANCELLED, FAILED, FINISHED, QUEUED, ServeError
+
+__all__ = ["RouterConfig", "Router", "Replica", "RouterRequest",
+           "HEALTHY", "DRAINING", "DRAINED", "DEAD"]
+
+HEALTHY = "healthy"
+DRAINING = "draining"    # no new work; in-flight requests finish here
+DRAINED = "drained"      # drain completed, replica retired
+DEAD = "dead"            # crashed or heartbeat-timed-out
+
+
+@dataclass(frozen=True)
+class RouterConfig:
+    """Control-plane policy.  Engine geometry lives in
+    :class:`EngineConfig`; this is purely routing/health/shedding."""
+    replicas: int = 2
+    heartbeat_timeout_ms: float = 5000.0
+    shed_queue_depth: Optional[int] = None  # None/0 = off
+    shed_kv_frac: float = 1.0               # >= this used-fraction sheds
+    max_failovers: int = 3                  # per request, then "error"
+
+    @classmethod
+    def from_env(cls, **overrides) -> "RouterConfig":
+        """Environment defaults (docs/env_vars.md round 13); explicit
+        kwargs win."""
+        env = dict(
+            replicas=_env_int("MXNET_TPU_SERVE_REPLICAS", 2),
+            heartbeat_timeout_ms=_env_float(
+                "MXNET_TPU_SERVE_HEARTBEAT_MS", 5000.0),
+            shed_queue_depth=(
+                _env_int("MXNET_TPU_SERVE_SHED_QUEUE", 0) or None),
+            shed_kv_frac=_env_float("MXNET_TPU_SERVE_SHED_KV_FRAC", 1.0),
+        )
+        env.update(overrides)
+        return cls(**env)
+
+
+@dataclass
+class Replica:
+    """One engine and its control-plane state."""
+    idx: int
+    engine: Engine
+    state: str = HEALTHY
+    death_cause: Optional[str] = None
+
+    @property
+    def load(self) -> int:
+        return self.engine.sched.active + self.engine.sched.queue_depth
+
+    def kv_frac(self) -> float:
+        used = self.engine.alloc.num_used
+        total = used + self.engine.alloc.num_free
+        return used / max(1, total)
+
+
+@dataclass
+class RouterRequest:
+    """The router's own view of a request — everything failover needs
+    survives here, never only inside a (mortal) replica."""
+    rid: int
+    prompt: List[int]
+    max_new_tokens: int
+    temperature: float
+    top_k: int
+    slo_ms: Optional[float]
+    eos_id: Optional[int]
+    deadline_ms: Optional[float]
+    seed: int
+    submit_t: float
+    state: str = ACTIVE
+    finish_reason: Optional[str] = None
+    tokens: List[int] = field(default_factory=list)  # synced each step
+    replica: Optional[Replica] = None
+    engine_rid: Optional[int] = None
+    failovers: int = 0
+    # set when this request's replica died; cleared (and the recovery
+    # latency recorded) when the adopting replica produces a token
+    recovering_since: Optional[float] = None
+
+    def done(self) -> bool:
+        return self.state in (FINISHED, CANCELLED, FAILED)
+
+
+class Router:
+    """See the module docstring.  ``chaos`` maps replica index ->
+    :class:`~mxnet_tpu.chaos.ChaosSpec` (or a bare spec, applied to
+    ``MXNET_TPU_CHAOS_REPLICA``); ``None`` reads the environment, an
+    empty dict forces chaos off.  ``clock`` is injectable so heartbeat
+    tests advance time without sleeping."""
+
+    def __init__(self, params: Dict[str, Any],
+                 engine_config: Optional[EngineConfig] = None,
+                 config: Optional[RouterConfig] = None, *,
+                 chaos: Optional[Any] = None,
+                 clock=time.monotonic):
+        self.config = config or RouterConfig.from_env()
+        self._clock = clock
+        n = int(self.config.replicas)
+        if n < 1:
+            raise MXNetError(f"replicas must be >= 1, got {n}")
+        engine_config = engine_config or EngineConfig.from_env()
+        if chaos is None:
+            spec = chaos_mod.serve_from_env()
+            chaos = {chaos_mod.chaos_replica(): spec} if spec else {}
+        if isinstance(chaos, chaos_mod.ChaosSpec):
+            chaos = {chaos_mod.chaos_replica(): chaos}
+        off = chaos_mod.ChaosSpec({})
+        self.replicas = [
+            Replica(idx=i, engine=Engine(params, engine_config,
+                                         chaos=chaos.get(i, off)))
+            for i in range(n)]
+        self._hb = Heartbeat(self.config.heartbeat_timeout_ms, clock=clock)
+        now = self._clock()
+        for rep in self.replicas:
+            self._hb.beat(rep.idx, now=now)
+        self._requests: Dict[int, RouterRequest] = {}
+        self._seq = itertools.count()
+        self._step_ms = 0.0           # EWMA router step wall (shed est.)
+        self.recoveries_ms: List[float] = []
+
+    # -- warmup ------------------------------------------------------------
+
+    def warmup(self) -> List[List[Dict[str, Any]]]:
+        """Warm every replica's program buckets (compile-cache hits
+        after the first replica — same fingerprint, same avals)."""
+        return [rep.engine.warmup() for rep in self.replicas]
+
+    # -- front door --------------------------------------------------------
+
+    def submit(self, prompt: Sequence[int], *, max_new_tokens: int = 32,
+               temperature: float = 0.0, top_k: int = 0,
+               slo_ms: Optional[float] = None,
+               eos_id: Optional[int] = None,
+               seed: Optional[int] = None,
+               deadline_ms: Optional[float] = None) -> int:
+        """Place a request on the least-loaded healthy replica, or shed
+        it (the request fails fast with reason ``"shed"``; ``result()``
+        raises :class:`ServeError`).  Without an explicit ``seed`` the
+        router id seeds the sampling stream — the router, not the
+        engine, must own seeds or failover could not replay them."""
+        rid = next(self._seq)
+        rr = RouterRequest(
+            rid=rid, prompt=[int(t) for t in prompt],
+            max_new_tokens=int(max_new_tokens),
+            temperature=float(temperature), top_k=int(top_k),
+            slo_ms=slo_ms, eos_id=eos_id, deadline_ms=deadline_ms,
+            seed=(int(seed) if seed is not None else rid),
+            submit_t=self._clock())
+        target = self._pick()
+        reason = self._shed_reason(rr, target)
+        if reason is not None:
+            rr.state = FAILED
+            rr.finish_reason = "shed"
+            self._requests[rid] = rr
+            telemetry.counter("serve.shed").inc(reason=reason)
+            telemetry.flight_recorder().record({
+                "kind": "serve.shed", "req": rid, "reason": reason,
+                "replica": None if target is None else target.idx})
+            return rid
+        # engine-side validation (empty/oversized prompt) propagates
+        # before the request is registered — a rejected submit leaves
+        # no ghost entry
+        rr.engine_rid = target.engine.submit(
+            rr.prompt, max_new_tokens=rr.max_new_tokens,
+            temperature=rr.temperature, top_k=rr.top_k, slo_ms=rr.slo_ms,
+            eos_id=rr.eos_id, seed=rr.seed, deadline_ms=rr.deadline_ms)
+        rr.replica = target
+        self._requests[rid] = rr
+        return rid
+
+    def cancel(self, rid: int) -> None:
+        rr = self._rr(rid)
+        if rr.done():
+            return
+        if (rr.replica is not None and rr.replica.state != DEAD
+                and rr.engine_rid is not None):
+            rr.replica.engine.cancel(rr.engine_rid)
+        else:
+            rr.state = CANCELLED
+            rr.finish_reason = "cancelled"
+
+    def request(self, rid: int) -> RouterRequest:
+        return self._rr(rid)
+
+    def _rr(self, rid: int) -> RouterRequest:
+        try:
+            return self._requests[rid]
+        except KeyError:
+            raise MXNetError(f"unknown request id {rid}")
+
+    # -- results -----------------------------------------------------------
+
+    def result(self, rid: int) -> List[int]:
+        """Drive the fleet until the request completes; raises
+        :class:`ServeError` (with the finish reason) on failure."""
+        rr = self._rr(rid)
+        guard = 0
+        limit = 10 * self.replicas[0].engine.config.max_seq_len + 100
+        while not rr.done():
+            self.step()
+            guard += 1
+            if guard > limit:
+                raise MXNetError(f"request {rid} failed to converge")
+        if rr.state == FAILED:
+            raise ServeError(rr.finish_reason or "error", rid)
+        return list(rr.tokens)
+
+    def stream(self, rid: int):
+        """Token generator; failover is invisible here except as
+        latency.  A failed request raises :class:`ServeError` after any
+        tokens already produced."""
+        rr = self._rr(rid)
+        cursor = 0
+        while True:
+            while cursor < len(rr.tokens):
+                yield rr.tokens[cursor]
+                cursor += 1
+            if rr.done():
+                if rr.state == FAILED:
+                    raise ServeError(rr.finish_reason or "error", rid)
+                return
+            self.step()
+
+    def run(self, max_steps: int = 100000) -> None:
+        """Drive the fleet until every submitted request completes."""
+        for _ in range(max_steps):
+            if all(rr.done() for rr in self._requests.values()):
+                return
+            self.step()
+        raise MXNetError(f"router still busy after {max_steps} steps")
+
+    # -- the control loop --------------------------------------------------
+
+    def step(self) -> None:
+        """One control-plane iteration: step live replicas (containing
+        crashes), check heartbeats, sync observed tokens, retire
+        finished drains, publish gauges."""
+        now = self._clock()
+        t0 = time.perf_counter()
+        for rep in self.replicas:
+            if rep.state not in (HEALTHY, DRAINING):
+                continue
+            eng = rep.engine
+            if eng.sched.idle():
+                # legitimately idle: the call itself proves liveness
+                self._hb.beat(rep.idx, now=now)
+                continue
+            try:
+                eng.step()
+            except Exception as exc:   # noqa: BLE001 — contain the death
+                self._declare_dead(rep, "crash", now, error=repr(exc))
+                continue
+            # progress-based: a hung step returns fine but never
+            # advances `beat`, so this beat does not register
+            self._hb.beat(rep.idx, progress=eng.beat, now=now)
+        for rep in self.replicas:
+            if (rep.state in (HEALTHY, DRAINING)
+                    and self._hb.age_ms(rep.idx, now=now)
+                    > self.config.heartbeat_timeout_ms):
+                self._declare_dead(rep, "heartbeat", now)
+        self._sync(now)
+        for rep in self.replicas:
+            if rep.state == DRAINING and rep.engine.sched.idle():
+                rep.state = DRAINED
+                self._hb.forget(rep.idx)
+        telemetry.gauge("serve.router.replicas_healthy").set(
+            sum(1 for r in self.replicas if r.state == HEALTHY))
+        ms = (time.perf_counter() - t0) * 1e3
+        self._step_ms = (ms if self._step_ms == 0.0
+                         else 0.8 * self._step_ms + 0.2 * ms)
+
+    def _sync(self, now: float) -> None:
+        """Pull every in-flight request's tokens into the router's own
+        buffer.  This runs every step BEFORE any future failover needs
+        it: the router can only replay what it has observed — a dead
+        replica's unsynced state is gone, exactly as it would be with
+        process-backed replicas."""
+        for rr in self._requests.values():
+            if rr.done() or rr.replica is None or rr.engine_rid is None:
+                continue
+            if rr.replica.state == DEAD:
+                continue
+            ereq = rr.replica.engine.requests.get(rr.engine_rid)
+            if ereq is None:
+                continue
+            fresh = ereq.tokens[len(rr.tokens):]
+            if fresh:
+                rr.tokens.extend(fresh)
+                if rr.recovering_since is not None:
+                    ms = (now - rr.recovering_since) * 1e3
+                    rr.recovering_since = None
+                    self.recoveries_ms.append(ms)
+                    telemetry.histogram(
+                        "serve.router.failover_ms").observe(ms)
+            if ereq.done():
+                rr.state = ereq.state
+                rr.finish_reason = ereq.finish_reason
+
+    # -- death & failover --------------------------------------------------
+
+    def _declare_dead(self, rep: Replica, cause: str, now: float,
+                      error: Optional[str] = None) -> None:
+        rep.state = DEAD
+        rep.death_cause = cause
+        self._hb.forget(rep.idx)
+        inflight = [rr for rr in self._requests.values()
+                    if not rr.done() and rr.replica is rep]
+        telemetry.counter("serve.router.deaths").inc(cause=cause)
+        telemetry.dump_flight("serve-replica-death", extra={
+            "replica": rep.idx, "cause": cause, "error": error,
+            "inflight": [rr.rid for rr in inflight]})
+        for rr in inflight:
+            self._failover(rr, now)
+
+    def _failover(self, rr: RouterRequest, now: float) -> None:
+        """Re-home one request onto a survivor, continuing its exact
+        token stream (see module docstring)."""
+        rr.failovers += 1
+        if rr.recovering_since is None:
+            rr.recovering_since = now
+        rr.replica = None
+        rr.engine_rid = None
+        if len(rr.tokens) >= rr.max_new_tokens:
+            # the final token was already observed; only the dead
+            # replica's finish bookkeeping was lost
+            rr.state = FINISHED
+            rr.finish_reason = "length"
+            return
+        target = self._pick()
+        if target is None or rr.failovers > self.config.max_failovers:
+            self._fail(rr, "error")
+            return
+        with telemetry.span("serve.router.failover", req=rr.rid,
+                            to=target.idx, tokens=len(rr.tokens)):
+            rr.engine_rid = target.engine.adopt(
+                rr.prompt, rr.tokens,
+                max_new_tokens=rr.max_new_tokens,
+                temperature=rr.temperature, top_k=rr.top_k,
+                slo_ms=rr.slo_ms, eos_id=rr.eos_id, seed=rr.seed,
+                deadline_ms=rr.deadline_ms, submit_t=rr.submit_t)
+        rr.replica = target
+        telemetry.counter("serve.router.failovers").inc()
+        telemetry.flight_recorder().record({
+            "kind": "serve.failover", "req": rr.rid, "to": target.idx,
+            "tokens_so_far": len(rr.tokens)})
+
+    def _fail(self, rr: RouterRequest, reason: str) -> None:
+        rr.state = FAILED
+        rr.finish_reason = reason
+
+    # -- drain -------------------------------------------------------------
+
+    def drain(self, idx: int) -> None:
+        """Graceful drain: the replica takes no new work; its ACTIVE
+        requests finish in place, its still-QUEUED ones migrate to
+        survivors immediately (no point waiting behind a closing
+        door)."""
+        rep = self.replicas[idx]
+        if rep.state != HEALTHY:
+            raise MXNetError(
+                f"replica {idx} is {rep.state}; only a healthy replica "
+                "drains")
+        rep.state = DRAINING
+        telemetry.counter("serve.router.drains").inc()
+        for rr in self._requests.values():
+            if rr.done() or rr.replica is not rep:
+                continue
+            ereq = rep.engine.requests.get(rr.engine_rid)
+            if ereq is None or ereq.state != QUEUED:
+                continue
+            # silent engine-side cancel: the router-level request lives
+            # on and re-homes with its original seed and submit time
+            rep.engine.sched.cancel(ereq)
+            rr.replica = None
+            rr.engine_rid = None
+            target = self._pick()
+            if target is None:
+                self._fail(rr, "error")
+                continue
+            rr.engine_rid = target.engine.adopt(
+                rr.prompt, rr.tokens,
+                max_new_tokens=rr.max_new_tokens,
+                temperature=rr.temperature, top_k=rr.top_k,
+                slo_ms=rr.slo_ms, eos_id=rr.eos_id, seed=rr.seed,
+                deadline_ms=rr.deadline_ms, submit_t=rr.submit_t)
+            rr.replica = target
+
+    # -- placement & shedding ----------------------------------------------
+
+    def _pick(self) -> Optional[Replica]:
+        """Least-loaded healthy replica with queue room (ties: lowest
+        index — deterministic placement, pinned by the failover parity
+        tests)."""
+        best = None
+        for rep in self.replicas:
+            if rep.state != HEALTHY:
+                continue
+            eng = rep.engine
+            if eng.sched.queue_depth >= eng.config.max_queue:
+                continue
+            key = (rep.load, rep.idx)
+            if best is None or key < best[0]:
+                best = (key, rep)
+        return None if best is None else best[1]
+
+    def _shed_reason(self, rr: RouterRequest,
+                     target: Optional[Replica]) -> Optional[str]:
+        """Why this submit should be shed, or ``None`` to accept.
+        Evaluated against the BEST candidate: if the least-loaded
+        replica is past threshold, the fleet is saturated."""
+        cfg = self.config
+        if target is None:
+            return "unavailable"
+        if (cfg.shed_queue_depth
+                and target.engine.sched.queue_depth >= cfg.shed_queue_depth):
+            return "queue"
+        if cfg.shed_kv_frac < 1.0 and target.kv_frac() >= cfg.shed_kv_frac:
+            return "kv"
+        if rr.slo_ms is not None and self._step_ms > 0.0:
+            est_wait = target.engine.sched.queue_depth * self._step_ms
+            if est_wait > rr.slo_ms:
+                return "slo"
+        return None
+
+    # -- introspection -----------------------------------------------------
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "replicas": [{
+                "idx": rep.idx, "state": rep.state,
+                "death_cause": rep.death_cause,
+                "active": rep.engine.sched.active,
+                "queued": rep.engine.sched.queue_depth,
+                "blocks_used": rep.engine.alloc.num_used,
+                "beat": rep.engine.beat,
+            } for rep in self.replicas],
+            "requests": len(self._requests),
+            "live": sum(1 for rr in self._requests.values()
+                        if not rr.done()),
+            "failovers": sum(rr.failovers
+                             for rr in self._requests.values()),
+            "recoveries_ms": list(self.recoveries_ms),
+            "step_ms_ewma": self._step_ms,
+        }

@@ -26,8 +26,8 @@ from typing import Any, Callable, List, Optional
 
 from ..base import MXNetError
 
-__all__ = ["Request", "Scheduler", "QUEUED", "ACTIVE", "FINISHED",
-           "CANCELLED", "FAILED"]
+__all__ = ["Request", "Scheduler", "ServeError", "QUEUED", "ACTIVE",
+           "FINISHED", "CANCELLED", "FAILED"]
 
 QUEUED = "queued"
 ACTIVE = "active"
@@ -36,6 +36,22 @@ CANCELLED = "cancelled"
 FAILED = "failed"
 
 _seq = itertools.count()
+
+
+class ServeError(MXNetError):
+    """A request finished unsuccessfully (timed out, shed, replica
+    error).  ``reason`` carries the finish reason — ``"timeout"``,
+    ``"shed"``, ``"error"`` — so callers can branch on it instead of
+    parsing a message; ``request_id`` names the request.  Raised by
+    ``Engine.result()``/``stream()`` and the router equivalents; a
+    failed request never surfaces as a bare KeyError/assert."""
+
+    def __init__(self, reason: str, request_id: int,
+                 message: Optional[str] = None):
+        self.reason = str(reason)
+        self.request_id = int(request_id)
+        super().__init__(
+            message or f"request {request_id} failed: {reason}")
 
 
 @dataclass
@@ -47,6 +63,7 @@ class Request:
     top_k: int = 0                    # 0 = full distribution
     slo_ms: Optional[float] = None    # per-token latency budget target
     eos_id: Optional[int] = None
+    deadline_ms: Optional[float] = None  # hard wall from submit_t
     # -- engine-managed state --
     id: int = field(default_factory=lambda: next(_seq))
     key: Any = None                   # per-request PRNG key (engine-set)
@@ -186,6 +203,8 @@ class Scheduler:
         req.finish_t = time.monotonic()
         if req in self.running:
             self.running.remove(req)
+        if req in self.queue:   # e.g. deadline expiry before admission
+            self.queue.remove(req)
         self._order.pop(req.id, None)
 
     # -- introspection ---------------------------------------------------

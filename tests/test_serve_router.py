@@ -1,0 +1,494 @@
+"""Serving control plane (mxnet_tpu/serve/router.py, docs/serving.md):
+health-checked routing, mid-stream failover, deadlines, shedding.
+
+The contracts under test, per issue 12's acceptance criteria:
+
+* **failover determinism**: kill a replica mid-decode under chaos and
+  the merged client-visible token stream is BYTE-IDENTICAL to the
+  no-failure run — with zero post-warmup retraces on the surviving
+  replica (``trace_counts`` pinned) and a clean allocator afterwards;
+* hung replica (``serve_hang``): ``step()`` returns but ``beat`` stops
+  advancing; the progress-based heartbeat declares it dead after the
+  timeout (fake clock — no sleeps) and its requests fail over;
+* NaN-poisoned logits (``serve_poison_logits``) finish the request
+  with reason ``"error"``, scrub the contaminated KV blocks, and the
+  next request reusing those blocks decodes exactly as a clean engine;
+* per-request deadlines expire ACTIVE and QUEUED requests with reason
+  ``"timeout"``, free their blocks, bump ``serve.timeouts``;
+* ``result()``/``stream()`` on a failed/timed-out/shed request raise
+  typed :class:`ServeError` carrying the finish reason — never a bare
+  KeyError/assert — and ``stream()`` yields partial tokens first;
+* graceful drain: no new placements, queued requests migrate, active
+  ones finish in place, streams stay byte-identical;
+* load shedding: queue-depth / KV-pressure / SLO-estimate thresholds
+  fail requests fast with reason ``"shed"``;
+* ``Engine.adopt`` replays the continuation of a half-finished stream
+  exactly (the mechanism failover rides on).
+"""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import telemetry
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.chaos import ChaosSpec, serve_from_env, chaos_replica
+from mxnet_tpu.models.transformer import transformer_lm
+from mxnet_tpu.resilience import Heartbeat
+from mxnet_tpu.serve import (Engine, EngineConfig, Router, RouterConfig,
+                             ServeError)
+from mxnet_tpu.serve.router import DEAD, DRAINED, DRAINING, HEALTHY
+
+V, NL, D, H = 61, 2, 32, 4
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    telemetry.reset_for_tests()
+    yield
+    telemetry.reset_for_tests()
+
+
+def _make_params(seed=0):
+    rng = np.random.RandomState(seed)
+    sym = transformer_lm(vocab_size=V, num_layers=NL, d_model=D, heads=H,
+                         batch_size=1, seq_len=8)
+    shapes, _, _ = sym.infer_shape(data=(1, 8), softmax_label=(1, 8))
+    return {n: (rng.randn(*s) * 0.05).astype(np.float32)
+            for n, s in zip(sym.list_arguments(), shapes)
+            if n not in ("data", "softmax_label")}
+
+
+_PARAMS = _make_params()
+
+_ECFG = dict(heads=H, block_size=4, num_blocks=64, max_batch=4,
+             max_prompt_len=16, max_seq_len=48, prompt_bucket_min=8)
+
+_RS = np.random.RandomState(7)
+_PROMPTS = [list(map(int, _RS.randint(1, V, _RS.randint(3, 10))))
+            for _ in range(6)]
+# mixed greedy / seeded-sampling workload: failover must replay BOTH
+_KW = [dict(max_new_tokens=10, temperature=(0.8 if i % 2 else 0.0),
+            top_k=(5 if i % 2 else 0), seed=100 + i)
+       for i in range(len(_PROMPTS))]
+
+
+def _engine(chaos=ChaosSpec({}), **over):
+    cfg = dict(_ECFG)
+    cfg.update(over)
+    return Engine(_PARAMS, EngineConfig(**cfg), chaos=chaos)
+
+
+def _router(rcfg=None, chaos={}, clock=None, **over):
+    cfg = dict(_ECFG)
+    cfg.update(over)
+    kw = {} if clock is None else {"clock": clock}
+    return Router(_PARAMS, EngineConfig(**cfg),
+                  rcfg or RouterConfig(replicas=2), chaos=chaos, **kw)
+
+
+def _reference_streams():
+    """The no-failure run every chaos scenario must reproduce."""
+    router = _router()
+    router.warmup()
+    ids = [router.submit(p, **k) for p, k in zip(_PROMPTS, _KW)]
+    router.run()
+    return [router.request(i).tokens for i in ids]
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+# ---------------------------------------------------------------------------
+# Heartbeat (resilience.py)
+# ---------------------------------------------------------------------------
+
+def test_heartbeat_progress_based():
+    clk = _Clock()
+    hb = Heartbeat(timeout_ms=100, clock=clk)
+    assert hb.beat("a", progress=0)           # first observation
+    clk.t = 0.05
+    assert not hb.beat("a", progress=0)       # no progress -> no beat
+    assert hb.age_ms("a") == pytest.approx(50)
+    assert hb.beat("a", progress=1)
+    assert hb.age_ms("a") == 0
+    clk.t = 0.2
+    assert not hb.beat("a", progress=1)
+    assert hb.stale() == ["a"]
+    hb.beat("b")                              # progress-less: always beats
+    clk.t = 0.25
+    assert hb.beat("b")
+    assert hb.stale() == ["a"]
+    hb.forget("a")
+    assert hb.stale() == []
+    assert hb.age_ms("never-seen") == 0       # unknown is not dead
+
+
+# ---------------------------------------------------------------------------
+# Chaos spec: serve kinds
+# ---------------------------------------------------------------------------
+
+def test_chaos_serve_kinds_parse_and_filter(monkeypatch):
+    spec = ChaosSpec.parse("serve_crash:4|nan:2|serve_hang:7")
+    assert spec.at("serve_crash", 4) and spec.at("serve_hang", 7)
+    with pytest.raises(ValueError):
+        ChaosSpec.parse("serve_typo:1")
+    monkeypatch.setenv("MXNET_TPU_CHAOS", "nan:3|serve_poison_logits:5")
+    sub = serve_from_env()
+    assert sub.at("serve_poison_logits", 5)
+    assert not sub.at("nan", 3)               # data kinds stay with ChaosIter
+    monkeypatch.setenv("MXNET_TPU_CHAOS", "nan:3")
+    assert serve_from_env() is None
+    monkeypatch.setenv("MXNET_TPU_CHAOS_REPLICA", "2")
+    assert chaos_replica() == 2
+    monkeypatch.delenv("MXNET_TPU_CHAOS_REPLICA")
+    assert chaos_replica() == 0
+
+
+# ---------------------------------------------------------------------------
+# Router basics
+# ---------------------------------------------------------------------------
+
+def test_router_matches_single_engine_streams():
+    # a request routed through the fleet decodes token-for-token as it
+    # would on a lone engine given the same seed
+    eng = _engine()
+    eng.warmup()
+    alone = []
+    for p, k in zip(_PROMPTS[:3], _KW[:3]):
+        alone.append(eng.result(eng.submit(p, **k)))
+    router = _router()
+    router.warmup()
+    ids = [router.submit(p, **k) for p, k in zip(_PROMPTS[:3], _KW[:3])]
+    assert [router.result(i) for i in ids] == alone
+    # placement is deterministic least-loaded: both replicas used
+    assert {router.request(i).replica.idx for i in ids} == {0, 1}
+
+
+def test_router_rejects_bad_submit_without_ghost_entry():
+    router = _router()
+    with pytest.raises(MXNetError):
+        router.submit([])
+    with pytest.raises(MXNetError):
+        router.submit([1] * 99)
+    assert router.stats()["requests"] == 0
+
+
+# ---------------------------------------------------------------------------
+# THE headline: mid-stream replica death -> byte-identical failover
+# ---------------------------------------------------------------------------
+
+def test_failover_crash_mid_stream_byte_identical():
+    ref = _reference_streams()
+    router = _router(chaos={0: ChaosSpec({"serve_crash": {4}})})
+    router.warmup()
+    snap = {rep.idx: dict(rep.engine.trace_counts)
+            for rep in router.replicas}
+    ids = [router.submit(p, **k) for p, k in zip(_PROMPTS, _KW)]
+    router.run()
+    # every request completed despite the death...
+    assert [router.request(i).state for i in ids] == ["finished"] * len(ids)
+    # ...and the merged streams are byte-identical to the clean run
+    assert [router.request(i).tokens for i in ids] == ref
+    dead, surv = router.replicas
+    assert dead.state == DEAD and dead.death_cause == "crash"
+    assert surv.state == HEALTHY
+    # zero post-warmup retraces on the survivor (acceptance criterion)
+    assert dict(surv.engine.trace_counts) == snap[1]
+    # the survivor released every block it touched
+    assert surv.engine.alloc.num_used == 0
+    flat = telemetry.snapshot_flat()
+    assert flat.get("serve.router.deaths{cause=crash}") == 1
+    assert flat.get("serve.router.failovers", 0) >= 1
+    assert router.stats()["failovers"] >= 1
+    assert len(router.recoveries_ms) >= 1
+
+
+def test_failover_stream_is_seamless_to_the_client():
+    ref = _reference_streams()
+    router = _router(chaos={0: ChaosSpec({"serve_crash": {4}})})
+    router.warmup()
+    ids = [router.submit(p, **k) for p, k in zip(_PROMPTS, _KW)]
+    # drive via stream() of a request on the DYING replica: the client
+    # just sees tokens, never the failure
+    victim = next(i for i in ids if router.request(i).replica.idx == 0)
+    assert list(router.stream(victim)) == ref[ids.index(victim)]
+    router.run()
+    assert [router.request(i).tokens for i in ids] == ref
+
+
+# ---------------------------------------------------------------------------
+# Hung replica -> heartbeat timeout (fake clock, no sleeps)
+# ---------------------------------------------------------------------------
+
+def test_hang_heartbeat_timeout_failover():
+    ref = _reference_streams()
+    clk = _Clock()
+    router = _router(RouterConfig(replicas=2, heartbeat_timeout_ms=500),
+                     chaos={0: ChaosSpec({"serve_hang": {3}})}, clock=clk)
+    router.warmup()
+    ids = [router.submit(p, **k) for p, k in zip(_PROMPTS, _KW)]
+    for _ in range(5):
+        router.step()
+    # the replica is wedged: step() returns but beat stopped advancing,
+    # so it is NOT yet dead (clock hasn't moved)...
+    assert router.replicas[0].engine._hung
+    assert router.replicas[0].state == HEALTHY
+    beat_before = router.replicas[0].engine.beat
+    router.step()
+    assert router.replicas[0].engine.beat == beat_before
+    # ...until the timeout elapses
+    clk.t = 1.0
+    router.step()
+    assert router.replicas[0].state == DEAD
+    assert router.replicas[0].death_cause == "heartbeat"
+    router.run()
+    assert [router.request(i).tokens for i in ids] == ref
+    flat = telemetry.snapshot_flat()
+    assert flat.get("serve.router.deaths{cause=heartbeat}") == 1
+
+
+# ---------------------------------------------------------------------------
+# NaN/Inf logits guard (+ chaos serve_poison_logits)
+# ---------------------------------------------------------------------------
+
+def test_poison_logits_finishes_error_and_scrubs():
+    clean = _engine()
+    clean.warmup()
+    ref = clean.result(clean.submit(_PROMPTS[2], **_KW[2]))
+
+    eng = _engine(chaos=ChaosSpec({"serve_poison_logits": {3}}))
+    eng.warmup()
+    a = eng.submit(_PROMPTS[0], **_KW[0])
+    b = eng.submit(_PROMPTS[1], **_KW[1])
+    for rid in (a, b):
+        with pytest.raises(ServeError) as exc:
+            eng.result(rid)
+        assert exc.value.reason == "error"
+        assert exc.value.request_id == rid
+        assert eng.request(rid).state == "failed"
+        assert eng.request(rid).blocks == []
+    assert eng.alloc.num_used == 0
+    flat = telemetry.snapshot_flat()
+    assert flat.get("serve.nan_logits") == 2
+    assert flat.get("serve.chaos_injected{kind=poison}") == 1
+    assert any(k.startswith("serve.evictions{reason=error")
+               or "reason=error" in k for k in flat
+               if k.startswith("serve.evictions"))
+    # blocks contaminated by the poisoned step were scrubbed: the next
+    # request reusing them decodes exactly as on a clean engine
+    assert eng.result(eng.submit(_PROMPTS[2], **_KW[2])) == ref
+
+
+def test_poison_logits_under_chunked_prefill():
+    eng = _engine(chaos=ChaosSpec({"serve_poison_logits": {1}}),
+                  prefill_chunk=8)
+    eng.warmup()
+    rid = eng.submit(_PROMPTS[0], **_KW[0])
+    with pytest.raises(ServeError) as exc:
+        eng.result(rid)
+    assert exc.value.reason == "error"
+    assert eng.alloc.num_used == 0
+
+
+# ---------------------------------------------------------------------------
+# Deadlines
+# ---------------------------------------------------------------------------
+
+def test_deadline_expires_active_request():
+    eng = _engine()
+    eng.warmup()
+    rid = eng.submit(_PROMPTS[0], max_new_tokens=10, deadline_ms=0.0)
+    with pytest.raises(ServeError) as exc:
+        eng.result(rid)
+    assert exc.value.reason == "timeout"
+    req = eng.request(rid)
+    assert req.state == "failed" and req.finish_reason == "timeout"
+    assert req.blocks == [] and eng.alloc.num_used == 0
+    assert telemetry.snapshot_flat().get("serve.timeouts") == 1
+
+
+def test_deadline_expires_queued_request():
+    eng = _engine(max_batch=2)
+    eng.warmup()
+    hogs = [eng.submit(_PROMPTS[i], max_new_tokens=20, seed=i)
+            for i in range(2)]
+    queued = eng.submit(_PROMPTS[2], max_new_tokens=4, deadline_ms=0.0)
+    eng.step()
+    req = eng.request(queued)
+    assert req.state == "failed" and req.finish_reason == "timeout"
+    assert req not in eng.sched.queue        # no zombie admission later
+    eng.run()
+    assert all(eng.request(h).state == "finished" for h in hogs)
+
+
+def test_deadline_config_default_applies():
+    eng = _engine(deadline_ms=0.0)
+    eng.warmup()
+    rid = eng.submit(_PROMPTS[0], max_new_tokens=4)
+    with pytest.raises(ServeError) as exc:
+        eng.result(rid)
+    assert exc.value.reason == "timeout"
+
+
+# ---------------------------------------------------------------------------
+# Typed errors
+# ---------------------------------------------------------------------------
+
+def test_serve_error_is_typed_and_stream_yields_partial_first():
+    eng = _engine(chaos=ChaosSpec({"serve_poison_logits": {4}}))
+    eng.warmup()
+    rid = eng.submit(_PROMPTS[0], max_new_tokens=10, seed=1)
+    got = []
+    with pytest.raises(ServeError) as exc:
+        for tok in eng.stream(rid):
+            got.append(tok)
+    # tokens produced before the failure were yielded, then the typed
+    # error surfaced — not a bare KeyError/assert, not silent truncation
+    assert got == eng.request(rid).tokens
+    assert len(got) >= 1
+    assert isinstance(exc.value, MXNetError)
+    assert exc.value.reason == "error" and exc.value.request_id == rid
+
+
+# ---------------------------------------------------------------------------
+# Drain
+# ---------------------------------------------------------------------------
+
+def test_drain_migrates_queued_and_finishes_active():
+    ref = _reference_streams()
+    router = _router(max_batch=2)   # small slots so some requests queue
+    router.warmup()
+    ids = [router.submit(p, **k) for p, k in zip(_PROMPTS, _KW)]
+    router.step()
+    router.drain(0)
+    assert router.replicas[0].state == DRAINING
+    with pytest.raises(MXNetError):
+        router.drain(0)             # only a healthy replica drains
+    # new work avoids the draining replica
+    extra = router.submit(_PROMPTS[0], max_new_tokens=4, seed=999)
+    assert router.request(extra).replica.idx == 1
+    router.run()
+    assert router.replicas[0].state == DRAINED
+    assert [router.request(i).tokens for i in ids] == ref
+    assert telemetry.snapshot_flat().get("serve.router.drains") == 1
+    # a drained replica left nothing behind
+    assert router.replicas[0].engine.alloc.num_used == 0
+
+
+# ---------------------------------------------------------------------------
+# Shedding
+# ---------------------------------------------------------------------------
+
+def test_shed_on_queue_depth():
+    router = _router(RouterConfig(replicas=1, shed_queue_depth=2))
+    router.warmup()
+    ids = [router.submit(p, max_new_tokens=4, seed=i)
+           for i, p in enumerate(_PROMPTS * 2)]
+    shed = [i for i in ids if router.request(i).finish_reason == "shed"]
+    kept = [i for i in ids if i not in shed]
+    assert shed and kept
+    router.run()
+    assert all(router.request(i).state == "finished" for i in kept)
+    with pytest.raises(ServeError) as exc:
+        router.result(shed[0])
+    assert exc.value.reason == "shed"
+    flat = telemetry.snapshot_flat()
+    assert flat.get("serve.shed{reason=queue}", 0) == len(shed)
+
+
+def test_shed_on_kv_pressure():
+    router = _router(RouterConfig(replicas=1, shed_kv_frac=0.01))
+    router.warmup()
+    first = router.submit(_PROMPTS[0], max_new_tokens=6, seed=1)
+    router.step()               # blocks now held -> kv_frac over threshold
+    second = router.submit(_PROMPTS[1], max_new_tokens=4, seed=2)
+    assert router.request(second).finish_reason == "shed"
+    router.run()
+    assert router.request(first).state == "finished"
+    assert telemetry.snapshot_flat().get("serve.shed{reason=kv}") == 1
+
+
+def test_shed_on_slo_estimate():
+    router = _router(RouterConfig(replicas=1), max_batch=1)
+    router.warmup()
+    a = router.submit(_PROMPTS[0], max_new_tokens=12, seed=1)
+    b = router.submit(_PROMPTS[1], max_new_tokens=12, seed=2)  # queues
+    for _ in range(3):
+        router.step()           # establishes the step-latency EWMA
+    hopeless = router.submit(_PROMPTS[2], max_new_tokens=4, seed=3,
+                             slo_ms=1e-6)
+    assert router.request(hopeless).finish_reason == "shed"
+    router.run()
+    assert all(router.request(i).state == "finished" for i in (a, b))
+    assert telemetry.snapshot_flat().get("serve.shed{reason=slo}") == 1
+
+
+def test_all_replicas_dead_sheds_unavailable():
+    router = _router(RouterConfig(replicas=1),
+                     chaos={0: ChaosSpec({"serve_crash": {2}})})
+    router.warmup()
+    rid = router.submit(_PROMPTS[0], max_new_tokens=8, seed=1)
+    router.run()                # death, failover finds no survivor
+    assert router.request(rid).state == "failed"
+    with pytest.raises(ServeError):
+        router.result(rid)
+    late = router.submit(_PROMPTS[1], max_new_tokens=4, seed=2)
+    assert router.request(late).finish_reason == "shed"
+    assert telemetry.snapshot_flat().get(
+        "serve.shed{reason=unavailable}") == 1
+
+
+# ---------------------------------------------------------------------------
+# adopt(): the replay mechanism failover rides on
+# ---------------------------------------------------------------------------
+
+def test_adopt_replays_exact_continuation():
+    eng_a = _engine()
+    eng_a.warmup()
+    full = eng_a.result(eng_a.submit(_PROMPTS[1], **_KW[1]))
+    # hand the first 4 tokens to a different engine mid-stream
+    eng_b = _engine()
+    eng_b.warmup()
+    rid = eng_b.adopt(_PROMPTS[1], full[:4],
+                      max_new_tokens=_KW[1]["max_new_tokens"],
+                      temperature=_KW[1]["temperature"],
+                      top_k=_KW[1]["top_k"], seed=_KW[1]["seed"])
+    assert eng_b.result(rid) == full
+    assert telemetry.snapshot_flat().get("serve.adopted") == 1
+
+
+def test_adopt_requires_seed_and_room():
+    eng = _engine()
+    with pytest.raises(MXNetError):
+        eng.adopt(_PROMPTS[0], [1, 2], max_new_tokens=8)   # no seed
+    with pytest.raises(MXNetError):
+        eng.adopt(_PROMPTS[0], [1, 2], max_new_tokens=2, seed=1)
+
+
+# ---------------------------------------------------------------------------
+# Config / env plumbing
+# ---------------------------------------------------------------------------
+
+def test_router_config_from_env(monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_SERVE_REPLICAS", "3")
+    monkeypatch.setenv("MXNET_TPU_SERVE_HEARTBEAT_MS", "750")
+    monkeypatch.setenv("MXNET_TPU_SERVE_SHED_QUEUE", "9")
+    monkeypatch.setenv("MXNET_TPU_SERVE_SHED_KV_FRAC", "0.85")
+    cfg = RouterConfig.from_env()
+    assert cfg.replicas == 3
+    assert cfg.heartbeat_timeout_ms == 750
+    assert cfg.shed_queue_depth == 9
+    assert cfg.shed_kv_frac == 0.85
+    assert RouterConfig.from_env(replicas=1).replicas == 1
+
+
+def test_engine_deadline_from_env(monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_SERVE_DEADLINE_MS", "1234")
+    assert EngineConfig.from_env(heads=H).deadline_ms == 1234

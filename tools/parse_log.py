@@ -294,7 +294,13 @@ def diff_serve(path_a, path_b):
     5% noise floor) and neither p99 per-token latency nor p99 TTFT may
     grow more than 10% — the triage gate for serving-path changes.
     The TTFT gate skips rows where either side predates the field
-    (r10 reports carry only p50 TTFT)."""
+    (r10 reports carry only p50 TTFT).
+
+    Chaos rows (``bench.py --serve --chaos`` failover scenario) are
+    gated on correctness, not latency: the scenario in report B must
+    have completed every request with zero tokens lost and
+    byte-identical streams — a failover that drops or mutates tokens
+    is a correctness regression no throughput can buy back."""
     a, b = read_serve(path_a), read_serve(path_b)
     common = [m for m in a if m in b]
     if not common:
@@ -334,6 +340,19 @@ def diff_serve(path_a, path_b):
     only = [m for m in (set(a) | set(b)) if m not in common]
     if only:
         print(f"\n(unmatched configs: {sorted(only)})", file=sys.stderr)
+    for metric, rec in b.items():
+        if "chaos" not in metric:
+            continue
+        if rec.get("completed") != rec.get("total"):
+            worse.append(
+                f"{metric}: chaos scenario incomplete "
+                f"({rec.get('completed')}/{rec.get('total')} requests)")
+        if rec.get("tokens_lost", 0) != 0:
+            worse.append(f"{metric}: failover lost "
+                         f"{rec.get('tokens_lost')} tokens (must be 0)")
+        if rec.get("streams_identical") is False:
+            worse.append(f"{metric}: failover streams diverged from the "
+                         "no-failure run")
     for msg in worse:
         print(f"REGRESSED: {msg}", file=sys.stderr)
     return 1 if worse else 0

@@ -15,7 +15,11 @@ and asserts:
    carries the serve.prefill / serve.decode / serve.admit spans, and
    the metrics registry holds the serve.tokens_total counter, the
    serve.prefill_chunks counter (every prompt ingested through the
-   chunk pump), and the fp8-aware kv_bytes_per_token gauge.
+   chunk pump), and the fp8-aware kv_bytes_per_token gauge;
+4. the round-12 control plane survives replica death: a 2-replica
+   Router with a serve_crash chaos point on replica 0 finishes every
+   stream byte-identical to a chaos-free fleet, with at least one
+   failover and zero post-warmup retraces on the survivor.
 
 Exit 0 on success, 1 with a reason on any failure.  Runs on the CPU
 mesh in a few seconds; invoked by tools/ci_check.sh after the
@@ -125,11 +129,61 @@ def main() -> None:
         fail(f"trace missing serve spans {sorted(missing)} "
              f"(have {sorted(info['span_names'])})")
 
+    # 4. control plane: replica crash mid-stream must be invisible to
+    # clients.  Same params, 2 replicas, 4 mixed greedy/sampled
+    # streams; the chaos fleet crashes replica 0 a few steps in.
+    from mxnet_tpu.chaos import ChaosSpec
+    from mxnet_tpu.serve import Router, RouterConfig
+
+    ecfg = EngineConfig(
+        heads=H, block_size=4, num_blocks=64, max_batch=4,
+        max_prompt_len=16, max_seq_len=48, prompt_bucket_min=8,
+        prefill_chunk=8, kv_quant="fp8")
+    rprompts = prompts[:4]
+    rkw = [dict(max_new_tokens=8, temperature=0.8 * (i % 2), seed=50 + i)
+           for i in range(4)]
+
+    def fleet(chaos):
+        telemetry.reset_for_tests()
+        rt = Router(params, engine_config=ecfg,
+                    config=RouterConfig(replicas=2), chaos=chaos)
+        rt.warmup()
+        rids = [rt.submit(p, **kw) for p, kw in zip(rprompts, rkw)]
+        warm = [dict(rep.engine.trace_counts) for rep in rt.replicas]
+        rt.run()
+        return rt, rids, warm
+
+    ref, ref_ids, _ = fleet({})
+    want_streams = [list(ref.request(i).tokens) for i in ref_ids]
+
+    rt, rids, warm = fleet({0: ChaosSpec({"serve_crash": {4}})})
+    flat = telemetry.snapshot_flat()
+    if flat.get("serve.router.deaths{cause=crash}", 0) < 1:
+        fail("chaos serve_crash never fired (no replica death recorded)")
+    if flat.get("serve.router.failovers", 0) < 1:
+        fail("replica died but no request failed over")
+    for i, rid in enumerate(rids):
+        req = rt.request(rid)
+        if not req.done() or req.state != "finished":
+            fail(f"router stream {rid} ended {req.state!r} after crash")
+        if list(req.tokens) != want_streams[i]:
+            fail(f"failover stream {rid} diverged: {list(req.tokens)} "
+                 f"!= {want_streams[i]} (must be byte-identical)")
+    survivor = rt.replicas[1]
+    if dict(survivor.engine.trace_counts) != warm[1]:
+        fail("survivor retraced during failover: "
+             f"{dict(survivor.engine.trace_counts)} != {warm[1]}")
+    if survivor.engine.alloc.num_used != 0:
+        fail(f"survivor leaked {survivor.engine.alloc.num_used} KV "
+             "blocks after failover drain")
+
     print(f"serve_smoke: OK (8 streams, {want} tokens, "
           f"{eng.step_idx} steps, {int(chunks)} prefill chunks, "
           f"fp8 kv {want_bpt} B/token, traces "
           f"{sum(traces_warm.values())} at warmup + 0 after, "
-          f"{info['events']} trace events, dir={tmp})")
+          f"{info['events']} trace events, "
+          f"{int(flat.get('serve.router.failovers', 0))} failovers "
+          "byte-identical, dir={0})".format(tmp))
 
 
 if __name__ == "__main__":
