@@ -901,6 +901,18 @@ def bench_serve(args):
     run, and that the survivor ran zero post-warmup retraces.
     ``parse_log.py --diff-serve`` gates that the chaos row completed
     every request.
+
+    With ``--hotswap`` (ISSUE 13) a rolling-deploy scenario rides along
+    and the report lands in ``BENCH_r13.json``: the 2-replica fleet
+    runs the mix clean, then again with ``Router.rolling_swap``
+    installing a **null update** mid-run — same values, fresh buffers,
+    so the row isolates the control-plane cost (drain + install) and
+    stream byte-identity is a correctness check rather than luck (a
+    real update would legitimately change tokens of requests admitted
+    after the swap).  The row records per-replica swap latency and the
+    throughput fraction vs the clean run (the tokens/s dip);
+    ``parse_log.py --diff-serve`` gates its correctness fields and
+    swap-latency growth.
     """
     import jax
     from mxnet_tpu.models.transformer import transformer_lm
@@ -1086,8 +1098,88 @@ def bench_serve(args):
             "n_devices": len(jax.devices()),
         })
         _emit_row(rows[-1])
+    if getattr(args, "hotswap", False):
+        from mxnet_tpu.serve import Router, RouterConfig
+        cfg = EngineConfig(heads=H, block_size=16, num_blocks=256,
+                           max_batch=4, max_queue=max(64, n_req),
+                           max_prompt_len=64, max_seq_len=128,
+                           prompt_bucket_min=16)
+        rcfg = RouterConfig(replicas=2)
+
+        def fleet(swap):
+            router = Router(params, cfg, rcfg, chaos={})
+            router.warmup()
+            warm = [dict(rep.engine.trace_counts)
+                    for rep in router.replicas]
+            t0 = time.perf_counter()
+            ids = [router.submit(p, max_new_tokens=m, seed=i)
+                   for i, (p, m) in enumerate(reqs)]
+            summary = None
+            if swap:
+                for _ in range(max(4, new_tok // 2)):
+                    router.step()          # streams mid-flight
+                # null update: identical values in fresh buffers — the
+                # drain/install cost is values-independent, and byte-
+                # identity stays a hard check even for requests that
+                # migrate onto an already-swapped replica
+                summary = router.rolling_swap(
+                    {k: np.array(v, copy=True)
+                     for k, v in params.items()})
+            router.run()
+            return router, ids, warm, time.perf_counter() - t0, summary
+
+        ref_router, ref_ids, _, ref_wall, _ = fleet(False)
+        ref = [ref_router.request(i).tokens for i in ref_ids]
+        router, ids, warm, wall, summary = fleet(True)
+        got = [router.request(i).tokens for i in ids]
+        completed = sum(1 for i in ids
+                        if router.request(i).state == "finished")
+        tokens_lost = sum(max(0, len(a) - len(b))
+                          for a, b in zip(ref, got))
+        retraces = sum(
+            sum(dict(rep.engine.trace_counts).values())
+            - sum(warm[rep.idx].values())
+            for rep in router.replicas)
+        swaps = sum(rep.engine.swap_count for rep in router.replicas)
+        tok_s_ref = sum(len(t) for t in ref) / ref_wall
+        tok_s_swap = sum(len(t) for t in got) / wall
+        frac = tok_s_swap / tok_s_ref
+        swap_ms = summary["swap_ms"]
+        rows.append({
+            "metric": f"serve hotswap rolling deploy (2 replicas, "
+                      f"{n_req} reqs x {new_tok} new tokens, {dev})",
+            "value": round(max(swap_ms), 2),
+            "unit": "ms max replica swap (drain + install)",
+            "vs_baseline": None,
+            "swap_ms": [round(m, 2) for m in swap_ms],
+            "swap_ms_max": round(max(swap_ms), 2),
+            "swap_mode": summary["mode"],
+            "tokens_s": round(tok_s_swap, 1),
+            "ref_tokens_s": round(tok_s_ref, 1),
+            "throughput_frac": round(frac, 3),
+            "completed": completed,
+            "total": len(ids),
+            "tokens_lost": tokens_lost,
+            "streams_identical": bool(got == ref),
+            "retraces_after_warmup": retraces,
+            "weight_swaps": swaps,
+            "wall_s": round(wall, 2),
+            "target": "hot mode, all requests complete, 0 tokens lost, "
+                      "streams byte-identical (null update), zero "
+                      "retraces, both replicas swapped, >= 0.5x clean "
+                      "tokens/s through the swap",
+            "pass": bool(summary["mode"] == "hot"
+                         and completed == len(ids) and tokens_lost == 0
+                         and got == ref and retraces == 0
+                         and swaps == len(router.replicas)
+                         and frac >= 0.5),
+            "n_devices": len(jax.devices()),
+        })
+        _emit_row(rows[-1])
     out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                       "BENCH_r12.json" if getattr(args, "chaos", False)
+                       "BENCH_r13.json" if getattr(args, "hotswap", False)
+                       else "BENCH_r12.json"
+                       if getattr(args, "chaos", False)
                        else "BENCH_r11.json")
     with open(out, "w") as f:
         json.dump(rows, f, indent=2)
@@ -1364,6 +1456,11 @@ def main():
                     "(chaos-killed replica mid-decode; recovery "
                     "latency, tokens lost must be 0, streams "
                     "byte-identical) -> BENCH_r12.json")
+    ap.add_argument("--hotswap", action="store_true",
+                    help="--serve: add the rolling-deploy scenario "
+                    "(Router.rolling_swap of a null update mid-run; "
+                    "per-replica swap latency, tokens/s dip, streams "
+                    "byte-identical, zero retraces) -> BENCH_r13.json")
     args = ap.parse_args()
     if args.compute_dtype == "none":
         args.compute_dtype = None

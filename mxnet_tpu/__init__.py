@@ -59,6 +59,7 @@ from .monitor import Monitor
 from . import rtc
 from . import predictor
 from . import serve
+from . import online
 from . import telemetry
 from . import profiler
 from . import resilience
@@ -80,5 +81,5 @@ __all__ = [
     "Initializer", "Uniform", "Normal", "Xavier", "Orthogonal", "Optimizer",
     "save_checkpoint", "load_checkpoint", "checkpoint", "CheckpointManager",
     "compile_cache", "resilience", "chaos", "analysis", "telemetry",
-    "profiler", "monitor", "Monitor", "serve",
+    "profiler", "monitor", "Monitor", "serve", "online",
 ]

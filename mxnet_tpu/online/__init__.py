@@ -1,0 +1,30 @@
+"""The train→serve deployment loop (docs/train_serve.md).
+
+The subsystem that closes the gap between the hardened trainer and
+the fault-tolerant serving fleet — continuous deployment and online
+post-training under live traffic:
+
+* :mod:`~mxnet_tpu.online.compat` — the ONE weight-compatibility
+  predicate (key set / shapes / dtypes) shared by
+  ``Engine.swap_weights``, ``Router.rolling_swap``, and
+  ``tools/ckpt_inspect.py diff --compat``, plus the architecture/
+  compat stamp published into checkpoint manifests.
+* :mod:`~mxnet_tpu.online.loop` — the online post-training harness:
+  seeded-sampling rollouts off the live fleet, a rejection-sampling
+  weighted-NLL training objective, checkpoint publish with the compat
+  stamp, and compat-gated ``rolling_swap`` deployment.
+
+The swap mechanics themselves live where the state lives:
+``Engine.swap_weights`` (zero-retrace operand swap) and
+``Router.rolling_swap`` (drain-guarded replica-by-replica deploy) in
+:mod:`mxnet_tpu.serve`.
+"""
+from . import compat, loop
+from .compat import (CompatReport, check_compat, compat_stamp,
+                     signature_of_manifest, signature_of_params)
+from .loop import OnlineConfig, OnlineLoop, make_rollout_trainer
+
+__all__ = ["CompatReport", "check_compat", "compat_stamp",
+           "signature_of_manifest", "signature_of_params",
+           "OnlineConfig", "OnlineLoop", "make_rollout_trainer",
+           "compat", "loop"]

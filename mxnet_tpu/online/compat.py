@@ -1,0 +1,163 @@
+"""Weight-compatibility predicate for zero-downtime hot-swap.
+
+ONE predicate decides whether a new set of weights can be installed
+into a running :class:`~mxnet_tpu.serve.engine.Engine` without
+recompilation: the key set, per-array shapes, and per-array dtypes
+must all match.  Weights are program *operands* (``engine.py``
+``_step_params``), so a signature-identical swap reuses every warm
+AOT program — zero retraces by construction.  A signature mismatch
+means new avals, which means new programs AND a stale KV layout, so
+the deployment path must rebuild the replica instead (its KV entries
+are invalidated; queued requests re-prefill elsewhere via the
+``Engine.adopt`` drain machinery).
+
+The same predicate backs three surfaces (docs/train_serve.md):
+
+* ``Engine.swap_weights`` refuses an incompatible install;
+* ``Router.rolling_swap`` picks hot-swap vs. replica rebuild per the
+  verdict;
+* ``tools/ckpt_inspect.py diff --compat`` prints the verdict as JSON
+  for scripts (exit 0 compatible / 1 incompatible).
+
+The **compat stamp** is the manifest-side of the story: a small JSON
+block the publisher (``online/loop.py``) writes into the checkpoint
+manifest ``meta`` under ``"compat"`` so a deployment can be gated
+before any shard file is read — architecture (vocab / num_layers /
+d_model / heads) plus a digest of the full name:shape:dtype
+signature.
+"""
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..base import MXNetError
+
+__all__ = ["CompatReport", "signature_of_params", "signature_of_manifest",
+           "check_compat", "compat_stamp", "STAMP_FORMAT"]
+
+STAMP_FORMAT = 1
+
+# a trainer checkpoint namespaces weights ``param:``, a model
+# checkpoint ``arg:``; both describe the same serving weights.  aux /
+# optimizer / side state never flows into serving programs, so it
+# cannot break a swap and is excluded from the signature.
+_WEIGHT_PREFIXES = ("arg:", "param:")
+_EXCLUDED_PREFIXES = ("aux:", "opt:")
+
+Signature = Dict[str, Tuple[Tuple[int, ...], str]]
+
+
+@dataclass
+class CompatReport:
+    """Machine-readable verdict of :func:`check_compat`."""
+    compatible: bool
+    added: List[str] = field(default_factory=list)      # only in B
+    removed: List[str] = field(default_factory=list)    # only in A
+    changed: List[Dict[str, Any]] = field(default_factory=list)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"compatible": self.compatible, "added": self.added,
+                "removed": self.removed, "changed": self.changed}
+
+    def summary(self) -> str:
+        if self.compatible:
+            return "compatible"
+        return ("incompatible: "
+                f"+{len(self.added)} arrays, -{len(self.removed)} arrays, "
+                f"{len(self.changed)} shape/dtype changes")
+
+
+def _shape_dtype(v: Any) -> Tuple[Tuple[int, ...], str]:
+    if hasattr(v, "asnumpy"):        # NDArray
+        return tuple(int(d) for d in v.shape), np.dtype(v.dtype).name
+    return (tuple(int(d) for d in v.shape),
+            np.dtype(getattr(v, "dtype", np.float32)).name)
+
+
+def signature_of_params(params: Dict[str, Any]) -> Signature:
+    """``{name: (shape, dtype)}`` for an in-memory parameter dict
+    (numpy / jax / NDArray values)."""
+    return {str(k): _shape_dtype(v) for k, v in params.items()}
+
+
+def signature_of_manifest(manifest: Dict[str, Any]) -> Signature:
+    """Weight signature of a checkpoint manifest (no shard reads).
+
+    Keys named ``arg:X`` / ``param:X`` normalize to ``X`` so a trainer
+    state checkpoint and a ``save_model`` checkpoint of the same
+    weights compare equal; ``aux:`` / ``opt:`` side state is ignored.
+    A manifest with no prefixed arrays (a raw ``save``) is taken
+    as-is."""
+    arrays = manifest["arrays"]
+    prefixed = {k for k in arrays
+                if k.startswith(_WEIGHT_PREFIXES)}
+    sig: Signature = {}
+    for name, entry in arrays.items():
+        if prefixed:
+            if name not in prefixed:
+                continue
+            key = name.split(":", 1)[1]
+        else:
+            if name.startswith(_EXCLUDED_PREFIXES):
+                continue
+            key = name
+        # manifests serialize dtype as the byte-order str ("<f4");
+        # normalize to the canonical name so a manifest signature and
+        # an in-memory one compare equal
+        sig[key] = (tuple(int(d) for d in entry["shape"]),
+                    np.dtype(entry["dtype"]).name)
+    return sig
+
+
+def check_compat(sig_a: Signature, sig_b: Signature) -> CompatReport:
+    """Can weights with signature ``sig_b`` hot-swap into a consumer
+    currently running ``sig_a``?  Pure structural comparison — values
+    never matter (that is the entire point of a weight update)."""
+    added = sorted(set(sig_b) - set(sig_a))
+    removed = sorted(set(sig_a) - set(sig_b))
+    changed = []
+    for name in sorted(set(sig_a) & set(sig_b)):
+        (sa, da), (sb, db) = sig_a[name], sig_b[name]
+        if sa != sb or da != db:
+            changed.append({"name": name,
+                            "a": {"shape": list(sa), "dtype": da},
+                            "b": {"shape": list(sb), "dtype": db}})
+    return CompatReport(
+        compatible=not (added or removed or changed),
+        added=added, removed=removed, changed=changed)
+
+
+def _sig_digest(sig: Signature) -> str:
+    h = hashlib.sha1()
+    for name in sorted(sig):
+        shape, dtype = sig[name]
+        h.update(f"{name}:{shape}:{dtype}\n".encode())
+    return h.hexdigest()
+
+
+def compat_stamp(params: Dict[str, Any],
+                 heads: Optional[int] = None) -> Dict[str, Any]:
+    """The architecture/compat stamp a publisher writes into the
+    checkpoint manifest ``meta["compat"]`` (docs/train_serve.md).
+
+    ``heads`` is not recoverable from parameter shapes
+    (``lm_config_from_params``) so the publisher supplies it from its
+    engine config; non-transformer_lm parameter dicts stamp with
+    ``arch: None`` (the signature digest still gates the swap)."""
+    sig = signature_of_params(params)
+    stamp = {"format": STAMP_FORMAT,
+             "arrays": len(sig),
+             "digest": _sig_digest(sig),
+             "arch": None}
+    try:
+        from ..models.transformer import lm_config_from_params
+        vocab, num_layers, d_model = lm_config_from_params(params)
+        stamp["arch"] = {"vocab": vocab, "num_layers": num_layers,
+                         "d_model": d_model, "heads": heads}
+    except MXNetError:
+        pass
+    return stamp

@@ -17,7 +17,10 @@ in, concurrent token streams come out.
   manifests or legacy ``.params``.
 * :mod:`~mxnet_tpu.serve.router` — the control plane: N engine
   replicas behind heartbeat health checks, mid-stream failover,
-  per-request deadlines, SLO-aware load shedding, graceful drain.
+  per-request deadlines, SLO-aware load shedding, graceful drain, and
+  zero-downtime rolling weight deploys (``rolling_swap`` +
+  ``Engine.swap_weights`` — the serve half of the round-13
+  train→serve loop, :mod:`mxnet_tpu.online` / docs/train_serve.md).
 """
 from . import engine, kvcache, router, scheduler
 from .engine import Engine, EngineConfig

@@ -257,6 +257,7 @@ class Engine:
         self.aot_stats = collections.Counter()
         self.requests: Dict[int, Request] = {}
         self.step_idx = 0
+        self.swap_count = 0      # successful swap_weights installs
         self._chunk_ms = 0.0   # EWMA chunk-prefill latency (SLO backlog)
         # "serve2": program outputs grew a finite-logits guard flag —
         # old cached executables have the wrong output arity
@@ -283,6 +284,53 @@ class Engine:
         from ..predictor import load_weights
         _, arg_params, _, _meta = load_weights(source, epoch)
         return cls(arg_params, config)
+
+    def swap_weights(self, params_or_source: Any,
+                     epoch: Optional[int] = None) -> Dict[str, Any]:
+        """Zero-downtime weight hot-swap: install a new checkpoint into
+        this running engine between steps (docs/train_serve.md).
+
+        ``params_or_source`` is a parameter dict or anything
+        :func:`~mxnet_tpu.predictor.load_weights` accepts.  Weights are
+        program *operands* (``_step_params``), so a signature-identical
+        swap reuses every warm AOT program — zero retraces, pinned by
+        ``trace_counts`` in tests/test_online.py.  KV entries survive:
+        same architecture, same pool layout (positions cached under the
+        old weights simply feed the new ones — in-flight streams see
+        the update at their next decode step; callers who need
+        request-boundary semantics drain first, which is exactly what
+        ``Router.rolling_swap`` does).
+
+        An incompatible signature (key set / shape / dtype delta)
+        raises :class:`MXNetError` without touching engine state — new
+        avals would mean new programs and a stale KV layout, so the
+        deployment path must rebuild the replica instead.  Returns the
+        :class:`~mxnet_tpu.online.compat.CompatReport` dict.
+        """
+        from ..online.compat import check_compat, signature_of_params
+        if isinstance(params_or_source, str):
+            from ..predictor import load_weights
+            _, params_or_source, _, _ = load_weights(params_or_source,
+                                                     epoch)
+        new = {k: jnp.asarray(
+            v.asnumpy() if hasattr(v, "asnumpy") else v)
+            for k, v in params_or_source.items()}
+        report = check_compat(signature_of_params(self._params),
+                              signature_of_params(new))
+        if not report.compatible:
+            raise MXNetError(
+                "swap_weights: incompatible weights — "
+                f"{report.summary()} (added={report.added[:4]} "
+                f"removed={report.removed[:4]} "
+                f"changed={[c['name'] for c in report.changed[:4]]}); "
+                "rebuild the engine (Router.rolling_swap does)")
+        self._params = new
+        # the NaN-poison cache was derived from the OLD weights; a
+        # later serve_poison_logits must poison the CURRENT ones
+        self._poison_params = None
+        self.swap_count += 1
+        telemetry.counter("online.swaps").inc()
+        return report.to_dict()
 
     # -- program construction ---------------------------------------------
 
@@ -936,6 +984,7 @@ class Engine:
             "queued": self.sched.queue_depth,
             "steps": self.step_idx,
             "beat": self.beat,
+            "weight_swaps": self.swap_count,
             "hung": self._hung,
             "chaos": bool(self.chaos),
             "prompt_buckets": list(self.prompt_buckets),

@@ -443,6 +443,97 @@ class Router:
                 deadline_ms=rr.deadline_ms, submit_t=rr.submit_t)
             rr.replica = target
 
+    # -- rolling weight swap -----------------------------------------------
+
+    def rolling_swap(self, params_or_source: Any, *,
+                     engine_config: Optional[EngineConfig] = None,
+                     allow_rebuild: Optional[bool] = None,
+                     epoch: Optional[int] = None,
+                     max_steps: int = 100000) -> Dict[str, Any]:
+        """Deploy new weights across the fleet with zero downtime
+        (docs/train_serve.md): replica-by-replica, each behind a
+        graceful drain, so **no in-flight stream ever sees a
+        mid-request weight change** — active requests finish in place
+        under the weights they started with, queued ones migrate to
+        not-yet-swapped survivors (``Engine.adopt`` re-prefill, the
+        standard drain machinery), and the rest of the fleet keeps
+        serving while one replica swaps.
+
+        ``params_or_source`` is a parameter dict or a checkpoint
+        source for :func:`~mxnet_tpu.predictor.load_weights`.  The
+        compat predicate (:mod:`mxnet_tpu.online.compat`) is evaluated
+        up front: a **compatible** signature hot-swaps each engine's
+        operands in place (KV pools and warm programs survive — zero
+        retraces); an **incompatible** one rebuilds each replica's
+        engine from scratch (its KV entries are invalidated
+        wholesale), gated by ``allow_rebuild`` (default: the
+        ``MXNET_TPU_ONLINE_REBUILD`` env knob, on).  With rebuild
+        forbidden an incompatible publish raises *before* any replica
+        is touched — the fleet keeps serving the old weights.
+
+        Returns a summary: per-replica ``swap_ms`` (drain wait +
+        install), the mode (``hot`` / ``rebuild``), and the compat
+        report.  ``online.swap_ms`` records each replica's latency;
+        rebuilds count in ``online.rebuilds``.  With a single replica
+        there is no survivor to migrate queued work to — queued
+        requests fail over to nothing and error; run >= 2 replicas
+        for actual zero-downtime deploys.
+        """
+        from ..online.compat import check_compat, signature_of_params
+        if allow_rebuild is None:
+            allow_rebuild = bool(_env_int("MXNET_TPU_ONLINE_REBUILD", 1))
+        if isinstance(params_or_source, str):
+            from ..predictor import load_weights
+            _, params_or_source, _, _ = load_weights(params_or_source,
+                                                     epoch)
+        new_sig = signature_of_params(params_or_source)
+        targets = [rep for rep in self.replicas if rep.state == HEALTHY]
+        if not targets:
+            raise MXNetError("rolling_swap: no healthy replica to swap")
+        report = check_compat(
+            signature_of_params(targets[0].engine._params), new_sig)
+        mode = "hot" if report.compatible else "rebuild"
+        if mode == "rebuild" and not allow_rebuild:
+            raise MXNetError(
+                "rolling_swap: incompatible weights and rebuild is "
+                f"disabled (MXNET_TPU_ONLINE_REBUILD=0) — "
+                f"{report.summary()}; fleet unchanged")
+        swap_ms: List[float] = []
+        with telemetry.span("online.rolling_swap", mode=mode,
+                            replicas=len(targets)):
+            for rep in targets:
+                t0 = time.perf_counter()
+                self.drain(rep.idx)
+                guard = 0
+                while rep.state == DRAINING:
+                    self.step()
+                    guard += 1
+                    if guard > max_steps:
+                        raise MXNetError(
+                            f"rolling_swap: replica {rep.idx} still "
+                            f"draining after {max_steps} steps")
+                if mode == "hot":
+                    rep.engine.swap_weights(params_or_source)
+                else:
+                    old = rep.engine
+                    rep.engine = Engine(
+                        params_or_source,
+                        engine_config or old.config,
+                        chaos=old.chaos or chaos_mod.ChaosSpec({}))
+                    rep.engine.warmup()
+                    telemetry.counter("online.rebuilds").inc()
+                rep.state = HEALTHY
+                rep.death_cause = None
+                self._hb.beat(rep.idx, now=self._clock())
+                ms = (time.perf_counter() - t0) * 1e3
+                swap_ms.append(ms)
+                telemetry.histogram("online.swap_ms").observe(ms)
+                telemetry.flight_recorder().record({
+                    "kind": "online.swap", "replica": rep.idx,
+                    "mode": mode, "ms": round(ms, 3)})
+        return {"mode": mode, "replicas": [rep.idx for rep in targets],
+                "swap_ms": swap_ms, "report": report.to_dict()}
+
     # -- placement & shedding ----------------------------------------------
 
     def _pick(self) -> Optional[Replica]:

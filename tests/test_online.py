@@ -1,0 +1,523 @@
+"""Train→serve loop (mxnet_tpu/online, docs/train_serve.md): weight
+hot-swap + online post-training.
+
+The contracts under test, per issue 13's acceptance criteria:
+
+* the compat predicate: key-set / shape / dtype structural verdict,
+  prefix normalization (``arg:`` / ``param:``), stamp digests — ONE
+  predicate shared by ``Engine.swap_weights``, ``Router.rolling_swap``
+  and ``ckpt_inspect.py diff --compat``;
+* ``Engine.swap_weights`` installs a compatible checkpoint with ZERO
+  retraces (weights are operands — pinned by ``trace_counts``) and
+  post-swap outputs match a fresh engine built from the new weights,
+  greedy and seeded; an incompatible install raises and leaves the
+  engine untouched;
+* satellite fix: the chaos NaN-poison cache is invalidated on swap —
+  ``serve_poison_logits`` must poison the *current* weights;
+* ``Router.rolling_swap`` deploys replica-by-replica behind drain:
+  in-flight streams finish byte-identical to a no-swap run (no
+  mid-request weight change), zero survivor retraces, and an
+  incompatible publish either rebuilds every replica (KV invalidated
+  wholesale, queued work re-homed via the adopt machinery) or — with
+  rebuild forbidden — raises with the fleet untouched;
+* the end-to-end loop: rollout → train → publish (compat stamp in the
+  manifest) → compat-gated ``rolling_swap`` onto a fleet serving live
+  streams, zero post-warmup retraces, post-swap outputs equal a fresh
+  engine loaded from the published checkpoint;
+* telemetry absorption: ``online.swaps`` / ``online.rebuilds`` /
+  ``online.swap_ms`` / ``online.rollout_tokens`` / ``online.rounds``
+  land in the one registry.
+"""
+import glob
+import json
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from mxnet_tpu import telemetry
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.checkpoint import CheckpointManager
+from mxnet_tpu.models.transformer import transformer_lm
+from mxnet_tpu.online import (OnlineConfig, OnlineLoop, check_compat,
+                              compat_stamp, make_rollout_trainer,
+                              signature_of_manifest, signature_of_params)
+from mxnet_tpu.serve import Engine, EngineConfig, Router, RouterConfig
+
+V, NL, D, H = 61, 2, 32, 4
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    telemetry.reset_for_tests()
+    yield
+    telemetry.reset_for_tests()
+
+
+def _make_params(seed=0, vocab=V):
+    rng = np.random.RandomState(seed)
+    sym = transformer_lm(vocab_size=vocab, num_layers=NL, d_model=D,
+                         heads=H, batch_size=1, seq_len=8)
+    shapes, _, _ = sym.infer_shape(data=(1, 8), softmax_label=(1, 8))
+    return {n: (rng.randn(*s) * 0.05).astype(np.float32)
+            for n, s in zip(sym.list_arguments(), shapes)
+            if n not in ("data", "softmax_label")}
+
+
+_A = _make_params(0)
+_B = _make_params(1)
+
+
+def _cfg(**over):
+    cfg = dict(heads=H, block_size=4, num_blocks=64, max_batch=4,
+               max_prompt_len=16, max_seq_len=48, prompt_bucket_min=8)
+    cfg.update(over)
+    return EngineConfig(**cfg)
+
+
+def _engine(params=_A, **over):
+    return Engine(params, _cfg(**over))
+
+
+def _router(params=_A, replicas=2, **over):
+    return Router(params, engine_config=_cfg(**over),
+                  config=RouterConfig(replicas=replicas), chaos={})
+
+
+def _mesh1():
+    """Single-device trainer mesh — the tiny test batch is not
+    divisible across the 8 faked devices."""
+    import jax
+    from mxnet_tpu.parallel import make_mesh
+    return make_mesh({"data": 1}, jax.devices()[:1])
+
+
+# mixed greedy/seeded workload — the no-swap yardstick runs it too
+_PROMPTS = [[3, 1, 4], [1, 5, 9, 2], [6, 5], [3, 5, 8, 9, 7]]
+_KW = [dict(max_new_tokens=12, temperature=0.0, seed=100),
+       dict(max_new_tokens=10, temperature=0.8, seed=101),
+       dict(max_new_tokens=12, temperature=0.0, seed=102),
+       dict(max_new_tokens=9, temperature=1.1, seed=103)]
+
+
+# ---------------------------------------------------------------------------
+# Compat predicate + stamp
+# ---------------------------------------------------------------------------
+
+def test_compat_predicate_structural():
+    a = {"w": np.zeros((2, 3), np.float32),
+         "b": np.zeros((3,), np.float32)}
+    assert check_compat(signature_of_params(a),
+                        signature_of_params(a)).compatible
+    # values never matter
+    b = {k: v + 1 for k, v in a.items()}
+    assert check_compat(signature_of_params(a),
+                        signature_of_params(b)).compatible
+    # shape change
+    r = check_compat(signature_of_params(a), signature_of_params(
+        {"w": np.zeros((2, 4), np.float32), "b": a["b"]}))
+    assert not r.compatible and [c["name"] for c in r.changed] == ["w"]
+    # dtype change
+    r = check_compat(signature_of_params(a), signature_of_params(
+        {"w": a["w"].astype(np.float16), "b": a["b"]}))
+    assert not r.compatible and r.changed[0]["b"]["dtype"] == "float16"
+    # key-set deltas
+    r = check_compat(signature_of_params(a), signature_of_params(
+        {"w": a["w"], "extra": a["b"]}))
+    assert r.added == ["extra"] and r.removed == ["b"]
+
+
+def test_compat_manifest_prefix_normalization():
+    entry = {"shape": [2, 3], "dtype": "<f4"}
+    trainer_like = {"arrays": {"param:w": entry, "aux:m": entry,
+                               "opt:w:0": entry}}
+    model_like = {"arrays": {"arg:w": entry, "aux:m": entry}}
+    sa = signature_of_manifest(trainer_like)
+    sb = signature_of_manifest(model_like)
+    assert sa == sb == {"w": ((2, 3), "float32")}
+    assert check_compat(sa, sb).compatible
+    # a manifest-side signature equals the in-memory one
+    assert sa == signature_of_params({"w": np.zeros((2, 3), np.float32)})
+
+
+def test_compat_stamp_arch_and_digest():
+    s = compat_stamp(_A, heads=H)
+    assert s["arch"] == {"vocab": V, "num_layers": NL, "d_model": D,
+                         "heads": H}
+    # same signature, different values -> same digest; different
+    # shapes -> different digest
+    assert s["digest"] == compat_stamp(_B, heads=H)["digest"]
+    grown = compat_stamp(_make_params(0, vocab=V + 4), heads=H)
+    assert grown["digest"] != s["digest"]
+    assert grown["arch"]["vocab"] == V + 4
+    # non-LM params still stamp (digest gates; arch is unknown)
+    assert compat_stamp({"w": np.zeros((2,), np.float32)})["arch"] is None
+
+
+# ---------------------------------------------------------------------------
+# Engine.swap_weights
+# ---------------------------------------------------------------------------
+
+def test_engine_swap_zero_retrace_outputs_match_fresh():
+    eng = _engine()
+    eng.warmup()
+    for p, kw in zip(_PROMPTS, _KW):
+        eng.submit(p, **kw)
+    eng.run()
+    warm = dict(eng.trace_counts)
+    report = eng.swap_weights(_B)
+    assert report["compatible"]
+    ids = [eng.submit(p, **kw) for p, kw in zip(_PROMPTS, _KW)]
+    eng.run()
+    # the swap itself and everything after it: zero new traces
+    assert dict(eng.trace_counts) == warm
+    assert eng.swap_count == 1 and eng.stats()["weight_swaps"] == 1
+    fresh = _engine(_B)
+    fresh.warmup()
+    fids = [fresh.submit(p, **kw) for p, kw in zip(_PROMPTS, _KW)]
+    fresh.run()
+    for rid, fid in zip(ids, fids):
+        assert eng.requests[rid].tokens == fresh.requests[fid].tokens, \
+            "post-swap stream must match a fresh engine on the new weights"
+    assert eng.alloc.num_used == 0
+
+
+def test_engine_swap_from_checkpoint_source(tmp_path):
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save_model(7, None, _B, {}, meta={"compat": compat_stamp(
+        _B, heads=H)}, blocking=True)
+    mgr.close()
+    eng = _engine()
+    eng.warmup()
+    eng.swap_weights(str(tmp_path))
+    rid = eng.submit([2, 4, 6], max_new_tokens=6)
+    eng.run()
+    fresh = _engine(_B)
+    fresh.warmup()
+    fid = fresh.submit([2, 4, 6], max_new_tokens=6)
+    fresh.run()
+    assert eng.requests[rid].tokens == fresh.requests[fid].tokens
+
+
+def test_engine_swap_incompatible_raises_untouched():
+    eng = _engine()
+    eng.warmup()
+    warm = dict(eng.trace_counts)
+    with pytest.raises(MXNetError, match="incompatible"):
+        eng.swap_weights(_make_params(2, vocab=V + 4))
+    assert eng.swap_count == 0
+    # the engine still serves the OLD weights, still warm
+    rid = eng.submit([1, 2, 3], max_new_tokens=5)
+    eng.run()
+    ref = _engine()
+    ref.warmup()
+    rr = ref.submit([1, 2, 3], max_new_tokens=5)
+    ref.run()
+    assert eng.requests[rid].tokens == ref.requests[rr].tokens
+    assert dict(eng.trace_counts) == warm
+
+
+def test_swap_invalidates_poison_cache():
+    """Satellite fix: the serve_poison_logits NaN cache was computed
+    once from the initial weights; after a swap it must rebuild from
+    the CURRENT ones."""
+    eng = _engine()
+    eng._poison_step = True
+    before = eng._step_params()
+    assert before is eng._poison_params
+    assert np.isnan(np.asarray(before["embed_weight"])).all()
+    eng.swap_weights(_B)
+    assert eng._poison_params is None, "swap must invalidate the cache"
+    after = eng._step_params()
+    assert after is not before
+    assert set(after) == set(eng._params)
+    assert np.isnan(np.asarray(after["lm_head_weight"])).all()
+
+
+# ---------------------------------------------------------------------------
+# Router.rolling_swap
+# ---------------------------------------------------------------------------
+
+def _reference_streams(params=_A):
+    rt = _router(params)
+    rt.warmup()
+    ids = [rt.submit(p, **kw) for p, kw in zip(_PROMPTS, _KW)]
+    rt.run()
+    return [list(rt.request(i).tokens) for i in ids]
+
+
+def test_rolling_swap_mid_stream_boundary_semantics():
+    """A swap landing mid-stream takes effect only at the next request
+    boundary: every in-flight stream (greedy AND seeded) finishes
+    byte-identical to a no-swap run — drain guarantees no mid-request
+    weight change — with zero retraces fleet-wide."""
+    want = _reference_streams()
+    telemetry.reset_for_tests()
+    rt = _router()
+    rt.warmup()
+    ids = [rt.submit(p, **kw) for p, kw in zip(_PROMPTS, _KW)]
+    for _ in range(3):
+        rt.step()           # streams genuinely mid-flight
+    assert any(not rt.request(i).done() for i in ids)
+    warm = [dict(rep.engine.trace_counts) for rep in rt.replicas]
+    summary = rt.rolling_swap(_B)
+    assert summary["mode"] == "hot"
+    assert len(summary["swap_ms"]) == 2
+    rt.run()
+    for i, rid in enumerate(ids):
+        req = rt.request(rid)
+        assert req.state == "finished"
+        assert list(req.tokens) == want[i], \
+            f"stream {rid} saw a mid-request weight change"
+    for rep in rt.replicas:
+        assert rep.state == "healthy"
+        assert dict(rep.engine.trace_counts) == warm[rep.idx]
+        assert rep.engine.alloc.num_used == 0
+        assert rep.engine.swap_count == 1
+    # requests AFTER the boundary run on the new weights
+    post = rt.submit([9, 8, 7], max_new_tokens=6, seed=55)
+    fresh = _engine(_B)
+    fresh.warmup()
+    fid = fresh.submit([9, 8, 7], max_new_tokens=6, seed=55)
+    fresh.run()
+    assert rt.result(post) == fresh.requests[fid].tokens
+    flat = telemetry.snapshot_flat()
+    assert flat.get("online.swaps") == 2
+    assert flat.get("online.swap_ms.count") == 2
+    assert flat.get("online.rebuilds") is None
+
+
+def test_streams_completed_before_swap_identical():
+    """A stream that completes entirely before the swap is trivially
+    byte-identical to a no-swap run — pinned so the swap path can
+    never perturb finished history."""
+    want = _reference_streams()
+    rt = _router()
+    rt.warmup()
+    ids = [rt.submit(p, **kw) for p, kw in zip(_PROMPTS, _KW)]
+    rt.run()
+    rt.rolling_swap(_B)
+    for i, rid in enumerate(ids):
+        assert list(rt.request(rid).tokens) == want[i]
+
+
+def test_rolling_swap_incompatible_rebuilds():
+    """An incompatible publish (vocab grew) cannot hot-swap: every
+    replica's engine is rebuilt behind drain — KV invalidated
+    wholesale, per-request re-homing via the standard adopt/drain
+    machinery — and the fleet then serves the new architecture."""
+    big = _make_params(3, vocab=V + 4)
+    rt = _router()
+    rt.warmup()
+    ids = [rt.submit(p, **kw) for p, kw in zip(_PROMPTS, _KW)]
+    rt.run()
+    engines_before = [rep.engine for rep in rt.replicas]
+    summary = rt.rolling_swap(big)
+    assert summary["mode"] == "rebuild"
+    assert not summary["report"]["compatible"]
+    for rep, old in zip(rt.replicas, engines_before):
+        assert rep.engine is not old
+        assert rep.state == "healthy"
+        assert rep.engine.vocab == V + 4
+    # streams finished before the swap kept their history
+    assert all(rt.request(i).state == "finished" for i in ids)
+    post = rt.submit([7, 7, 7], max_new_tokens=5)
+    fresh = Engine(big, _cfg())
+    fresh.warmup()
+    fid = fresh.submit([7, 7, 7], max_new_tokens=5)
+    fresh.run()
+    assert rt.result(post) == fresh.requests[fid].tokens
+    flat = telemetry.snapshot_flat()
+    assert flat.get("online.rebuilds") == 2
+
+
+def test_rolling_swap_rebuild_forbidden_fleet_untouched():
+    big = _make_params(3, vocab=V + 4)
+    rt = _router()
+    rt.warmup()
+    warm = [dict(rep.engine.trace_counts) for rep in rt.replicas]
+    with pytest.raises(MXNetError, match="rebuild is disabled"):
+        rt.rolling_swap(big, allow_rebuild=False)
+    # nothing drained, nothing swapped — the fleet serves on
+    for rep in rt.replicas:
+        assert rep.state == "healthy"
+        assert rep.engine.swap_count == 0
+        assert dict(rep.engine.trace_counts) == warm[rep.idx]
+    want = _reference_streams()
+    ids = [rt.submit(p, **kw) for p, kw in zip(_PROMPTS, _KW)]
+    rt.run()
+    assert [list(rt.request(i).tokens) for i in ids] == want
+
+
+def test_rolling_swap_env_rebuild_knob(monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_ONLINE_REBUILD", "0")
+    rt = _router()
+    rt.warmup()
+    with pytest.raises(MXNetError, match="rebuild is disabled"):
+        rt.rolling_swap(_make_params(3, vocab=V + 4))
+    # the explicit argument wins over the environment
+    summary = rt.rolling_swap(_make_params(3, vocab=V + 4),
+                              allow_rebuild=True)
+    assert summary["mode"] == "rebuild"
+
+
+# ---------------------------------------------------------------------------
+# ckpt_inspect diff --compat (the CLI face of the same predicate)
+# ---------------------------------------------------------------------------
+
+def test_ckpt_inspect_diff_compat_cli(tmp_path, capsys):
+    import importlib.util
+    import os
+    spec = importlib.util.spec_from_file_location(
+        "ckpt_inspect", os.path.join(os.path.dirname(__file__), "..",
+                                     "tools", "ckpt_inspect.py"))
+    ci = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ci)
+    roots = {}
+    for name, params in (("a", _A), ("b", _B),
+                         ("big", _make_params(2, vocab=V + 4))):
+        root = str(tmp_path / name)
+        mgr = CheckpointManager(root)
+        mgr.save_model(1, None, params, {}, meta={
+            "compat": compat_stamp(params, heads=H)}, blocking=True)
+        mgr.close()
+        roots[name] = glob.glob(root + "/step-*")[0]
+    assert ci.main(["diff", roots["a"], roots["b"], "--compat"]) == 0
+    verdict = json.loads(capsys.readouterr().out)
+    assert verdict["compatible"] is True
+    assert verdict["stamp_a"]["arch"]["vocab"] == V
+    assert ci.main(["diff", roots["a"], roots["big"], "--compat"]) == 1
+    verdict = json.loads(capsys.readouterr().out)
+    assert verdict["compatible"] is False
+    changed = {c["name"] for c in verdict["changed"]}
+    assert {"embed_weight", "lm_head_weight", "lm_head_bias"} <= changed
+    assert verdict["stamp_b"]["arch"]["vocab"] == V + 4
+    # plain diff still content-compares (same sig, different values)
+    assert ci.main(["diff", roots["a"], roots["b"]]) == 1
+
+
+# ---------------------------------------------------------------------------
+# The end-to-end loop (acceptance criteria)
+# ---------------------------------------------------------------------------
+
+def test_online_loop_end_to_end(tmp_path):
+    """train → publish (compat stamp) → compat-gated rolling_swap onto
+    a fleet serving live streams: streams byte-identical to a no-loop
+    run, ZERO post-warmup retraces, post-swap outputs equal a fresh
+    engine loaded from the published checkpoint."""
+    want = _reference_streams()
+    telemetry.reset_for_tests()
+    rt = _router()
+    rt.warmup()
+    live = [rt.submit(p, **kw) for p, kw in zip(_PROMPTS, _KW)]
+    for _ in range(2):
+        rt.step()
+    warm = [dict(rep.engine.trace_counts) for rep in rt.replicas]
+
+    trainer = make_rollout_trainer(_A, heads=H, batch=4, seq_len=24,
+                                   mesh=_mesh1())
+    mgr = CheckpointManager(str(tmp_path))
+    pr = np.random.RandomState(11)
+
+    def prompt_fn(round_idx, n):
+        return [list(map(int, pr.randint(1, V, 3))) for _ in range(n)]
+
+    loop = OnlineLoop(rt, trainer, mgr, prompt_fn=prompt_fn,
+                      reward_fn=lambda p, t: float(len(set(t))),
+                      config=OnlineConfig(rounds=1, rollouts=4,
+                                          max_new_tokens=6,
+                                          train_steps=2),
+                      base_seed=500)
+    results = loop.run()
+    assert len(results) == 1 and results[0]["swap"]["mode"] == "hot"
+    assert results[0]["rollout_tokens"] > 0
+
+    # live streams never dropped or diverged
+    for i, rid in enumerate(live):
+        req = rt.request(rid)
+        assert req.state == "finished"
+        assert list(req.tokens) == want[i]
+    # zero post-warmup retraces, fleet healthy, no KV leak
+    for rep in rt.replicas:
+        assert rep.state == "healthy"
+        assert dict(rep.engine.trace_counts) == warm[rep.idx]
+        assert rep.engine.alloc.num_used == 0
+
+    # the manifest carries the compat stamp, and the published weights
+    # REALLY are what the fleet now serves: a fresh engine cold-loaded
+    # from the checkpoint produces identical streams
+    from mxnet_tpu.checkpoint import layout
+    step_dir = layout.step_path(str(tmp_path), results[0]["step"])
+    stamp = layout.read_manifest(step_dir)["meta"]["compat"]
+    assert stamp["arch"] == {"vocab": V, "num_layers": NL,
+                             "d_model": D, "heads": H}
+    fresh = Engine.from_checkpoint(str(tmp_path), _cfg())
+    fresh.warmup()
+    for p, kw in zip(_PROMPTS, _KW):
+        got = rt.result(rt.submit(p, **kw))
+        fid = fresh.submit(p, **kw)
+        fresh.run()
+        assert got == fresh.requests[fid].tokens
+        assert got != []
+    mgr.close()
+
+    # telemetry absorption: the online counters land in the registry
+    flat = telemetry.snapshot_flat()
+    assert flat.get("online.rounds") == 1
+    assert flat.get("online.swaps") == 2
+    assert flat.get("online.swap_ms.count") == 2
+    assert flat.get("online.rollout_tokens") == \
+        results[0]["rollout_tokens"]
+    assert flat.get("online.weights_step") == results[0]["step"]
+
+
+def test_online_stats_absorbed():
+    """test_telemetry.py-style absorption: the engine-local swap
+    counters mirror into the one registry as they tick."""
+    rt = _router()
+    rt.warmup()
+    rt.rolling_swap(_B)
+    rt.rolling_swap(_A)
+    flat = telemetry.snapshot_flat()
+    swaps = sum(rep.engine.swap_count for rep in rt.replicas)
+    assert flat["online.swaps"] == swaps == 4
+    for rep in rt.replicas:
+        assert rep.engine.stats()["weight_swaps"] == 2
+    assert flat["online.swap_ms.count"] == 4
+    assert flat["online.swap_ms.sum"] > 0
+
+
+def test_loop_rejection_sampling_masks_batch():
+    """The weighted-NLL batch: prompt + padding positions always
+    masked, rejected sequences fully masked, kept sequences labeled
+    with their own next tokens."""
+    rt = _router()
+    rt.warmup()
+    trainer = make_rollout_trainer(_A, heads=H, batch=4, seq_len=24,
+                                   mesh=_mesh1())
+    loop = OnlineLoop(
+        rt, trainer, manager=None,
+        prompt_fn=lambda r, n: [[5, 6]] * n,
+        reward_fn=lambda p, t: float(t[0]),   # rank by first token
+        config=OnlineConfig(rounds=1, rollouts=4, max_new_tokens=4,
+                            train_steps=1, temperature=0.9,
+                            keep_frac=0.5))
+    batch = loop.rollout(0)
+    data, labels = batch["data"], batch["softmax_label"]
+    assert data.shape == labels.shape == (4, 24)
+    assert sum(batch["kept"]) == 2      # keep_frac of 4
+    for i, (toks, kept) in enumerate(zip(batch["tokens"],
+                                         batch["kept"])):
+        seq = [5, 6] + toks
+        assert list(data[i, :len(seq)]) == seq
+        assert (data[i, len(seq):] == 0).all()          # pad_id
+        # prompt positions never carry loss: label[0] predicts seq[1],
+        # which is still prompt
+        assert labels[i, 0] == -1
+        if kept:
+            gen = [labels[i, t] for t in range(1, len(seq) - 1)]
+            assert gen == toks[: len(gen)]
+        else:
+            assert (labels[i] == -1).all()
+    assert (labels[:, -1] == -1).all()  # no next token at the end

@@ -5,7 +5,7 @@ Usage::
 
     python tools/ckpt_inspect.py show  <ckpt-dir> [--verify]
     python tools/ckpt_inspect.py list  <root>
-    python tools/ckpt_inspect.py diff  <ckpt-dir-a> <ckpt-dir-b>
+    python tools/ckpt_inspect.py diff  <ckpt-dir-a> <ckpt-dir-b> [--compat]
 
 ``show`` prints the manifest: every array with shape, dtype, shard map
 (file, [start,stop) index, bytes, checksum), plus the meta block; with
@@ -15,6 +15,19 @@ checkpoint root.  ``diff`` compares two checkpoints structurally
 (arrays added/removed, shape/dtype changes) and by content (per-array
 checksums of assembled values) and exits 1 when they differ — the
 quick answer to "did this resume actually change anything?".
+
+``diff --compat`` answers the deployment question instead: can B's
+weights hot-swap into a consumer serving A's (docs/train_serve.md)?
+It prints ONE machine-readable JSON verdict — ``compatible`` plus the
+``added`` / ``removed`` / ``changed`` (shape/dtype) weight deltas and
+each side's manifest compat stamp when present — and exits 0 when
+compatible, 1 when not.  Values are never read or compared: a weight
+*update* is the point of a swap.  The verdict comes from the SAME
+predicate (``mxnet_tpu.online.compat.check_compat``) that
+``Engine.swap_weights`` enforces and ``Router.rolling_swap`` gates
+on, so the tool's answer and the runtime's behavior cannot drift;
+``arg:``/``param:`` prefixes normalize, so a trainer-state checkpoint
+and a ``save_model`` checkpoint of the same weights read compatible.
 """
 from __future__ import annotations
 
@@ -100,6 +113,8 @@ def cmd_diff(args) -> int:
     from mxnet_tpu.checkpoint import layout, reader
     ma = layout.read_manifest(args.a)
     mb = layout.read_manifest(args.b)
+    if getattr(args, "compat", False):
+        return _diff_compat(ma, mb)
     aa, ab = ma["arrays"], mb["arrays"]
     differs = False
     for name in sorted(set(aa) - set(ab)):
@@ -131,6 +146,21 @@ def cmd_diff(args) -> int:
     return 1 if differs else 0
 
 
+def _diff_compat(ma, mb) -> int:
+    """``diff --compat``: the hot-swap verdict, exit 0/1."""
+    import json
+
+    from mxnet_tpu.online.compat import (check_compat,
+                                         signature_of_manifest)
+    report = check_compat(signature_of_manifest(ma),
+                          signature_of_manifest(mb))
+    verdict = report.to_dict()
+    verdict["stamp_a"] = ma.get("meta", {}).get("compat")
+    verdict["stamp_b"] = mb.get("meta", {}).get("compat")
+    print(json.dumps(verdict, indent=2, sort_keys=True))
+    return 0 if report.compatible else 1
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         description="Inspect / diff mxnet_tpu sharded checkpoints")
@@ -146,6 +176,12 @@ def main(argv=None) -> int:
     p_diff = sub.add_parser("diff", help="diff two checkpoints")
     p_diff.add_argument("a")
     p_diff.add_argument("b")
+    p_diff.add_argument("--compat", action="store_true",
+                        help="print the hot-swap compatibility verdict "
+                        "as JSON (key-set/shape/dtype deltas only, no "
+                        "value reads); exit 0 compatible / 1 not — the "
+                        "same predicate Engine.swap_weights and "
+                        "Router.rolling_swap use")
     args = parser.parse_args(argv)
     return {"show": cmd_show, "list": cmd_list, "diff": cmd_diff}[args.cmd](
         args)

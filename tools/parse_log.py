@@ -286,6 +286,11 @@ SERVE_TTFT_GROWTH = 0.10  # p99 TTFT may grow up to 10%
 # from scheduler jitter alone; latency growth below this absolute delta
 # is noise, not regression, however large the percentage looks
 SERVE_LAT_SLACK_MS = 2.0
+# swap latency is drain-dominated (in-flight decode finishing), so it
+# wobbles with scheduler noise far more than a p99 over hundreds of
+# intervals does — gate only a blow-up, not jitter
+SWAP_MS_GROWTH = 0.50
+SWAP_MS_SLACK = 25.0
 
 
 def diff_serve(path_a, path_b):
@@ -300,7 +305,14 @@ def diff_serve(path_a, path_b):
     gated on correctness, not latency: the scenario in report B must
     have completed every request with zero tokens lost and
     byte-identical streams — a failover that drops or mutates tokens
-    is a correctness regression no throughput can buy back."""
+    is a correctness regression no throughput can buy back.
+
+    Hotswap rows (``bench.py --serve --hotswap`` rolling-deploy
+    scenario) get the same correctness gate plus two of their own: the
+    swap must have run zero post-warmup retraces (a retracing "hot"
+    swap is the bug the whole design exists to prevent), and the
+    per-replica swap latency may not blow up between reports (growth
+    over ``SWAP_MS_GROWTH`` beyond the absolute slack)."""
     a, b = read_serve(path_a), read_serve(path_b)
     common = [m for m in a if m in b]
     if not common:
@@ -341,18 +353,32 @@ def diff_serve(path_a, path_b):
     if only:
         print(f"\n(unmatched configs: {sorted(only)})", file=sys.stderr)
     for metric, rec in b.items():
-        if "chaos" not in metric:
+        if "chaos" not in metric and "hotswap" not in metric:
             continue
+        what = "failover" if "chaos" in metric else "rolling swap"
         if rec.get("completed") != rec.get("total"):
             worse.append(
-                f"{metric}: chaos scenario incomplete "
+                f"{metric}: scenario incomplete "
                 f"({rec.get('completed')}/{rec.get('total')} requests)")
         if rec.get("tokens_lost", 0) != 0:
-            worse.append(f"{metric}: failover lost "
+            worse.append(f"{metric}: {what} lost "
                          f"{rec.get('tokens_lost')} tokens (must be 0)")
         if rec.get("streams_identical") is False:
-            worse.append(f"{metric}: failover streams diverged from the "
-                         "no-failure run")
+            worse.append(f"{metric}: {what} streams diverged from the "
+                         "clean run")
+        if "hotswap" not in metric:
+            continue
+        if rec.get("retraces_after_warmup", 0) != 0:
+            worse.append(f"{metric}: hot swap retraced "
+                         f"{rec.get('retraces_after_warmup')} programs "
+                         "(must reuse every warm program)")
+        sa = a.get(metric, {}).get("swap_ms_max")
+        sb = rec.get("swap_ms_max")
+        if sa and sb is not None:
+            pct = (sb - sa) / sa
+            if pct > SWAP_MS_GROWTH and sb - sa > SWAP_MS_SLACK:
+                worse.append(f"{metric}: swap latency grew "
+                             f"{100 * pct:.0f}% ({sa:g} -> {sb:g} ms)")
     for msg in worse:
         print(f"REGRESSED: {msg}", file=sys.stderr)
     return 1 if worse else 0

@@ -19,7 +19,13 @@ and asserts:
 4. the round-12 control plane survives replica death: a 2-replica
    Router with a serve_crash chaos point on replica 0 finishes every
    stream byte-identical to a chaos-free fleet, with at least one
-   failover and zero post-warmup retraces on the survivor.
+   failover and zero post-warmup retraces on the survivor;
+5. the round-13 train→serve loop closes (docs/train_serve.md): a
+   rollout trainer takes a few steps from the serving weights, the
+   update publishes through CheckpointManager with the compat stamp,
+   and ``Router.rolling_swap`` deploys it under 8 live streams —
+   mode ``hot``, zero retraces, every stream finishes, no KV leak,
+   and ``online.swaps`` == replica count.
 
 Exit 0 on success, 1 with a reason on any failure.  Runs on the CPU
 mesh in a few seconds; invoked by tools/ci_check.sh after the
@@ -177,7 +183,71 @@ def main() -> None:
         fail(f"survivor leaked {survivor.engine.alloc.num_used} KV "
              "blocks after failover drain")
 
+    # 5. train -> publish -> rolling swap under live load.  8 streams
+    # in flight (4 per replica, both replicas saturated), then a
+    # weight update trained from the SAME serving weights deploys via
+    # the compat-stamped checkpoint — the swap must be hot (zero
+    # retraces) and invisible to the streams.
+    import jax
+
+    from mxnet_tpu.checkpoint import CheckpointManager
+    from mxnet_tpu.online import compat_stamp, make_rollout_trainer
+    from mxnet_tpu.parallel import make_mesh
+
+    telemetry.reset_for_tests()
+    rt5 = Router(params, engine_config=ecfg,
+                 config=RouterConfig(replicas=2), chaos={})
+    rt5.warmup()
+    live = [rt5.submit(p, max_new_tokens=m, temperature=0.8 * (i % 2),
+                       seed=200 + i)
+            for i, (p, m) in enumerate(zip(prompts, budgets))]
+    for _ in range(2):
+        rt5.step()                  # streams genuinely mid-flight
+    warm5 = [dict(rep.engine.trace_counts) for rep in rt5.replicas]
+
+    trainer = make_rollout_trainer(
+        params, heads=H, batch=8, seq_len=32,
+        mesh=make_mesh({"data": 1}, jax.devices()[:1]))
+    tr_rng = np.random.RandomState(7)
+    tdata = tr_rng.randint(1, V, (8, 32)).astype(np.float32)
+    tlabels = np.full((8, 32), -1, np.float32)
+    tlabels[:, :-1] = tdata[:, 1:]  # next-token; last position masked
+    for _ in range(3):
+        trainer.step({"data": tdata, "softmax_label": tlabels})
+    arg, aux = trainer.get_params()
+    mgr = CheckpointManager(os.path.join(tmp, "ckpt"))
+    mgr.save_model(int(trainer._num_update), trainer.symbol, arg, aux,
+                   meta={"compat": compat_stamp(dict(arg), heads=H)},
+                   blocking=True)
+    mgr.wait_until_finished()
+    summary = rt5.rolling_swap(mgr.directory)
+    mgr.close()
+    if summary["mode"] != "hot":
+        fail(f"trained update should hot-swap, got {summary['mode']} "
+             f"({summary['report']})")
+    rt5.run()
+    for rid in live:
+        req = rt5.request(rid)
+        if req.state != "finished":
+            fail(f"stream {rid} ended {req.state!r} across the swap")
+    for rep in rt5.replicas:
+        if dict(rep.engine.trace_counts) != warm5[rep.idx]:
+            fail(f"replica {rep.idx} retraced during hot swap: "
+                 f"{dict(rep.engine.trace_counts)} != {warm5[rep.idx]}")
+        if rep.engine.alloc.num_used != 0:
+            fail(f"replica {rep.idx} leaked {rep.engine.alloc.num_used} "
+                 "KV blocks across the swap")
+    flat = telemetry.snapshot_flat()
+    if flat.get("online.swaps") != len(rt5.replicas):
+        fail(f"online.swaps={flat.get('online.swaps')} != "
+             f"{len(rt5.replicas)} replicas swapped")
+    if flat.get("online.swap_ms.count") != len(rt5.replicas):
+        fail("online.swap_ms histogram missing per-replica swap latency")
+    swap_ms = summary["swap_ms"]
+
     print(f"serve_smoke: OK (8 streams, {want} tokens, "
+          f"hot-swap {len(swap_ms)} replicas "
+          f"[{', '.join(f'{m:.0f}ms' for m in swap_ms)}] under load, "
           f"{eng.step_idx} steps, {int(chunks)} prefill chunks, "
           f"fp8 kv {want_bpt} B/token, traces "
           f"{sum(traces_warm.values())} at warmup + 0 after, "
