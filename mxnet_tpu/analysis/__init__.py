@@ -8,8 +8,14 @@ Quick start::
     report = analysis.audit_trainer(trainer)        # typed findings
     analysis.assert_program_clean(trainer)          # pytest helper
     report = analysis.lint_paths(repo_root)         # AST linter
+    with analysis.audit_threads() as audit:         # lockset sanitizer
+        audit.track(obj, "_ring")
+        ...
+    analysis.run_schedules()                        # schedule fuzzer
 """
 
+from .concurrency import (ScheduleFuzzer, ThreadAudit, analyze_events,
+                          audit_threads, run_schedules)
 from .findings import (Finding, Report, RULES, SCHEMA_VERSION,
                        apply_cli, apply_inline, parse_inline_suppressions)
 from .program import (AuditConfig, assert_program_clean, audit_executor,
@@ -28,4 +34,6 @@ __all__ = [
     "update_passes",
     "ENV_PREFIX", "documented_env_vars", "env_reads_in_source",
     "lint_file", "lint_paths",
+    "ScheduleFuzzer", "ThreadAudit", "analyze_events",
+    "audit_threads", "run_schedules",
 ]

@@ -73,6 +73,29 @@ RULES: Dict[str, Tuple[str, str]] = {
     "source.donated-mutation": (
         "error", "a buffer is read or mutated after being donated "
         "(mark_donated / a donate_argnums call site)"),
+    "source.unguarded-shared-write": (
+        "error", "an attribute declared `# shared: guarded_by=<lock>` "
+        "is mutated outside a `with self.<lock>:` block (and outside "
+        "__init__, which is single-threaded construction)"),
+    "source.daemon-capture": (
+        "warn", "a daemon thread's target closure captures a local the "
+        "enclosing function rebinds after the thread starts — the "
+        "worker races the rebind"),
+    "conc.data-race": (
+        "error", "two threads touched the same shared mutable state "
+        "(at least one write) with no common lock and no "
+        "happens-before edge between the accesses (eraser-style "
+        "lockset intersection, vector-clock HB via Event/Queue/Thread/"
+        "Condition publish)"),
+    "conc.lock-order": (
+        "error", "the lock-acquisition graph has a cycle: two threads "
+        "acquire the same locks in opposite orders — a potential "
+        "deadlock even if this run got lucky"),
+    "conc.blocking-under-lock": (
+        "error", "a blocking operation (queue get/put, Event.wait, "
+        "Thread.join, time.sleep, file open) runs while holding a "
+        "framework lock — every other thread needing that lock stalls "
+        "behind the I/O"),
 }
 
 SEVERITIES = ("error", "warn", "info")

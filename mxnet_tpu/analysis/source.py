@@ -11,6 +11,19 @@ Rules (catalogue in docs/static_analysis.md):
                               variables missing from docs/env_vars.md
 - ``source.env-stale``        documented variables nothing reads
 - ``source.donated-mutation`` reading a buffer after it was donated
+- ``source.unguarded-shared-write``  an attribute declared
+                              ``# shared: guarded_by=<lock>`` mutated
+                              outside ``with self.<lock>:`` (and outside
+                              ``__init__``)
+- ``source.daemon-capture``   a ``Thread(daemon=True)`` target closure
+                              captures a local the enclosing function
+                              rebinds after the thread starts
+
+The shared-state pass is intraprocedural: only annotate attributes whose
+every mutation is *lexically* inside the owning ``with`` block (or in
+``__init__``) — helper methods that rely on "caller holds the lock" are
+the runtime sanitizer's job (``mxnet_tpu.analysis.concurrency``), not
+this one's.
 
 Traced-region detection is conservative: a function is traced when it is
 decorated with / passed to a tracing entry point (``jax.jit``,
@@ -441,6 +454,210 @@ def _lint_donated_mutation(fn: ast.FunctionDef, path: str,
 
 
 # ----------------------------------------------------------------------
+# Shared-state discipline: # shared: guarded_by=<lock>
+# ----------------------------------------------------------------------
+
+_GUARDED_RE = re.compile(r"#\s*shared:\s*guarded_by=([\w.,]+)")
+
+#: method names that mutate their receiver in place
+_MUTATORS = {
+    "append", "appendleft", "extend", "extendleft", "insert", "pop",
+    "popleft", "popitem", "remove", "clear", "update", "setdefault",
+    "add", "discard", "sort", "reverse", "rotate", "move_to_end",
+}
+
+
+def _guard_annotations(src: str) -> Dict[int, List[str]]:
+    """``{line: [lock names]}`` for every ``# shared: guarded_by=`` tag."""
+    out: Dict[int, List[str]] = {}
+    for i, text in enumerate(src.splitlines(), start=1):
+        m = _GUARDED_RE.search(text)
+        if m:
+            out[i] = [g.strip() for g in m.group(1).split(",") if g.strip()]
+    return out
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """``attr`` when node is exactly ``self.<attr>``, else None."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _lint_guarded_by(tree: ast.AST, src: str, path: str,
+                     report: Report) -> None:
+    """Per class: collect ``self.<attr>`` assignments tagged
+    ``# shared: guarded_by=<lock>``, then flag every mutation of a
+    tagged attribute that is not lexically inside ``with self.<lock>:``
+    — except in ``__init__``, which is single-threaded construction."""
+    ann = _guard_annotations(src)
+    if not ann:
+        return
+
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        guards: Dict[str, List[str]] = {}
+        for node in ast.walk(cls):
+            if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for tgt in targets:
+                    attr = _self_attr(tgt)
+                    if attr is None:
+                        continue
+                    for line in range(node.lineno,
+                                      (node.end_lineno or node.lineno) + 1):
+                        if line in ann:
+                            guards[attr] = ann[line]
+                            break
+        if not guards:
+            continue
+
+        def _flag(attr, node, fname, kind):
+            want = guards[attr]
+            report.add(Finding(
+                "source.unguarded-shared-write",
+                f"`self.{attr}` is declared shared (guarded_by="
+                f"{','.join(want)}) but {kind} in `{fname}` outside "
+                f"`with self.{want[0]}:`",
+                path=path, line=node.lineno,
+                details={"attr": attr, "guards": want, "method": fname}))
+
+        def _visit(node, held: Set[str], fname: str):
+            """One pass per node, carrying the lexically-held set."""
+            def guarded(attr):
+                return any(g in held for g in guards[attr])
+
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for s in node.body:   # nested def: nothing held inside
+                    _visit(s, set(), fname)
+                return
+            if isinstance(node, ast.With):
+                now = set(held)
+                for item in node.items:
+                    a = _self_attr(item.context_expr)
+                    if a is not None:
+                        now.add(a)
+                    _visit(item.context_expr, held, fname)
+                for s in node.body:
+                    _visit(s, now, fname)
+                return
+            if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for tgt in targets:
+                    attr = _self_attr(tgt)
+                    if attr in guards and not guarded(attr):
+                        _flag(attr, node, fname, "rebound")
+                    if isinstance(tgt, ast.Subscript):
+                        attr = _self_attr(tgt.value)
+                        if attr in guards and not guarded(attr):
+                            _flag(attr, node, fname, "item-assigned")
+            elif isinstance(node, ast.Delete):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Subscript):
+                        attr = _self_attr(tgt.value)
+                        if attr in guards and not guarded(attr):
+                            _flag(attr, node, fname, "item-deleted")
+            elif isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _MUTATORS:
+                attr = _self_attr(node.func.value)
+                if attr in guards and not guarded(attr):
+                    _flag(attr, node, fname,
+                          f"mutated via `.{node.func.attr}()`")
+            for child in ast.iter_child_nodes(node):
+                _visit(child, held, fname)
+
+        for item in cls.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if item.name == "__init__":
+                    continue
+                for s in item.body:
+                    _visit(s, set(), item.name)
+
+
+# ----------------------------------------------------------------------
+# Daemon-thread closure capture
+# ----------------------------------------------------------------------
+
+def _lint_daemon_capture(fn: ast.FunctionDef, path: str,
+                         report: Report) -> None:
+    """Flag ``threading.Thread(target=<nested def>, daemon=True)`` when
+    the nested def reads an enclosing local that the enclosing function
+    rebinds at a line AFTER the thread starts — the worker races the
+    rebind and may see either value."""
+    nested: Dict[str, ast.FunctionDef] = {
+        n.name: n for n in fn.body
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    if not nested:
+        return
+    # locals the enclosing fn rebinds, with every rebind line
+    rebinds: Dict[str, List[int]] = {}
+
+    def collect_rebinds(stmts):
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                tgts = (stmt.targets if isinstance(stmt, ast.Assign)
+                        else [stmt.target])
+                for tgt in tgts:
+                    for n in ast.walk(tgt):
+                        if isinstance(n, ast.Name):
+                            rebinds.setdefault(n.id, []).append(stmt.lineno)
+            for child in ast.iter_child_nodes(stmt):
+                if isinstance(child, ast.stmt):
+                    collect_rebinds([child])
+
+    collect_rebinds(fn.body)
+
+    for call in ast.walk(fn):
+        if not isinstance(call, ast.Call) or _call_name(call) != "Thread":
+            continue
+        daemon = any(kw.arg == "daemon"
+                     and isinstance(kw.value, ast.Constant)
+                     and kw.value.value is True
+                     for kw in call.keywords)
+        if not daemon:
+            continue
+        target = None
+        for kw in call.keywords:
+            if kw.arg == "target" and isinstance(kw.value, ast.Name):
+                target = kw.value.id
+        if call.args and isinstance(call.args[0], ast.Name):
+            target = target or call.args[0].id
+        worker = nested.get(target or "")
+        if worker is None:
+            continue
+        params = {a.arg for a in (worker.args.posonlyargs
+                                  + worker.args.args
+                                  + worker.args.kwonlyargs)}
+        bound_inside = {n.targets[0].id for n in ast.walk(worker)
+                        if isinstance(n, ast.Assign)
+                        and isinstance(n.targets[0], ast.Name)}
+        reads = {n.id for n in ast.walk(worker)
+                 if isinstance(n, ast.Name)
+                 and isinstance(n.ctx, ast.Load)}
+        captured = reads - params - bound_inside
+        for name in sorted(captured):
+            late = [ln for ln in rebinds.get(name, ())
+                    if ln > call.lineno and ln != worker.lineno]
+            if late:
+                report.add(Finding(
+                    "source.daemon-capture",
+                    f"daemon thread target `{target}` captures local "
+                    f"`{name}`, which `{fn.name}` rebinds at line "
+                    f"{late[0]} after the thread starts — the worker "
+                    "races the rebind",
+                    path=path, line=call.lineno,
+                    details={"local": name, "rebind_line": late[0]}))
+                break   # one finding per Thread call is enough
+
+
+# ----------------------------------------------------------------------
 # File / repo entry points
 # ----------------------------------------------------------------------
 
@@ -471,6 +688,8 @@ def lint_file(path: str, src: Optional[str] = None,
             if _is_traced_def(fn, index, traced_lines):
                 _TaintLinter(fn, index, rel, report).run()
             _lint_donated_mutation(fn, rel, report)
+            _lint_daemon_capture(fn, rel, report)
+    _lint_guarded_by(tree, src, rel, report)
     apply_inline(report.findings[start:], parse_inline_suppressions(src))
     return report
 

@@ -53,7 +53,12 @@ def _host_leaf(value) -> List[Tuple[Optional[List[List[int]]], np.ndarray]]:
         from ..ndarray import NDArray
         if isinstance(value, NDArray):
             return [(None, value.asnumpy())]
-        return [(None, np.asarray(value))]
+        # np.asarray on a host array ALIASES it — the async writer would
+        # then serialize whatever the next in-place train step left in
+        # the buffer, not the save-time bytes (caught by the
+        # ckpt_save_during_step schedule-fuzz scenario).  Snapshot means
+        # copy.
+        return [(None, np.array(value, copy=True))]
     out = []
     seen = set()
     for shard in shards_attr:

@@ -43,10 +43,25 @@ the flight recorder (``serve-replica-death`` dumps).  Failure
 injection comes from :mod:`mxnet_tpu.chaos` serve points
 (``serve_crash`` / ``serve_hang`` / ``serve_poison_logits``),
 targeted at one replica via ``MXNET_TPU_CHAOS_REPLICA``.
+
+**Threading model** (audited by ``staticcheck races`` +
+``staticcheck schedules``): the router is driven concurrently — a
+client thread pulling ``stream()``/``result()``, an ops thread calling
+``drain``/``rolling_swap``, the main loop calling ``step()``.  All
+mutation of control-plane state (the replica table, the request map,
+heartbeats, drain/swap transitions) happens under one reentrant
+``_lock``; ``step``, ``submit``, ``cancel``, ``drain``, ``stats`` and
+the install phase of ``rolling_swap`` serialize on it.  ``stream()``
+deliberately reads a request's ``tokens`` outside the lock: tokens are
+append-only and synced by the (locked) step, so a reader sees a clean
+prefix — the schedule fuzzer pins this with byte-identity invariants
+(``failover_during_decode``, ``rolling_swap_under_live_streams``,
+``heartbeat_drain_race`` in ``analysis/schedules.py``).
 """
 from __future__ import annotations
 
 import itertools
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence
@@ -171,7 +186,10 @@ class Router:
         now = self._clock()
         for rep in self.replicas:
             self._hb.beat(rep.idx, now=now)
-        self._requests: Dict[int, RouterRequest] = {}
+        # one reentrant lock serializes all control-plane mutation; see
+        # the module docstring's threading model
+        self._lock = threading.RLock()
+        self._requests: Dict[int, RouterRequest] = {}  # shared: guarded_by=_lock
         self._seq = itertools.count()
         self._step_ms = 0.0           # EWMA router step wall (shed est.)
         self.recoveries_ms: List[float] = []
@@ -196,46 +214,49 @@ class Router:
         raises :class:`ServeError`).  Without an explicit ``seed`` the
         router id seeds the sampling stream — the router, not the
         engine, must own seeds or failover could not replay them."""
-        rid = next(self._seq)
-        rr = RouterRequest(
-            rid=rid, prompt=[int(t) for t in prompt],
-            max_new_tokens=int(max_new_tokens),
-            temperature=float(temperature), top_k=int(top_k),
-            slo_ms=slo_ms, eos_id=eos_id, deadline_ms=deadline_ms,
-            seed=(int(seed) if seed is not None else rid),
-            submit_t=self._clock())
-        target = self._pick()
-        reason = self._shed_reason(rr, target)
-        if reason is not None:
-            rr.state = FAILED
-            rr.finish_reason = "shed"
+        with self._lock:
+            rid = next(self._seq)
+            rr = RouterRequest(
+                rid=rid, prompt=[int(t) for t in prompt],
+                max_new_tokens=int(max_new_tokens),
+                temperature=float(temperature), top_k=int(top_k),
+                slo_ms=slo_ms, eos_id=eos_id, deadline_ms=deadline_ms,
+                seed=(int(seed) if seed is not None else rid),
+                submit_t=self._clock())
+            target = self._pick()
+            reason = self._shed_reason(rr, target)
+            if reason is not None:
+                rr.state = FAILED
+                rr.finish_reason = "shed"
+                self._requests[rid] = rr
+                telemetry.counter("serve.shed").inc(reason=reason)
+                telemetry.flight_recorder().record({
+                    "kind": "serve.shed", "req": rid, "reason": reason,
+                    "replica": None if target is None else target.idx})
+                return rid
+            # engine-side validation (empty/oversized prompt) propagates
+            # before the request is registered — a rejected submit
+            # leaves no ghost entry
+            rr.engine_rid = target.engine.submit(
+                rr.prompt, max_new_tokens=rr.max_new_tokens,
+                temperature=rr.temperature, top_k=rr.top_k,
+                slo_ms=rr.slo_ms, eos_id=rr.eos_id, seed=rr.seed,
+                deadline_ms=rr.deadline_ms)
+            rr.replica = target
             self._requests[rid] = rr
-            telemetry.counter("serve.shed").inc(reason=reason)
-            telemetry.flight_recorder().record({
-                "kind": "serve.shed", "req": rid, "reason": reason,
-                "replica": None if target is None else target.idx})
             return rid
-        # engine-side validation (empty/oversized prompt) propagates
-        # before the request is registered — a rejected submit leaves
-        # no ghost entry
-        rr.engine_rid = target.engine.submit(
-            rr.prompt, max_new_tokens=rr.max_new_tokens,
-            temperature=rr.temperature, top_k=rr.top_k, slo_ms=rr.slo_ms,
-            eos_id=rr.eos_id, seed=rr.seed, deadline_ms=rr.deadline_ms)
-        rr.replica = target
-        self._requests[rid] = rr
-        return rid
 
     def cancel(self, rid: int) -> None:
-        rr = self._rr(rid)
-        if rr.done():
-            return
-        if (rr.replica is not None and rr.replica.state != DEAD
-                and rr.engine_rid is not None):
-            rr.replica.engine.cancel(rr.engine_rid)
-        else:
-            rr.state = CANCELLED
-            rr.finish_reason = "cancelled"
+        with self._lock:
+            rr = self._rr(rid)
+            if rr.done():
+                return
+            if (rr.replica is not None and rr.replica.state != DEAD
+                    and rr.engine_rid is not None):
+                rr.replica.engine.cancel(rr.engine_rid)
+            else:
+                rr.state = CANCELLED
+                rr.finish_reason = "cancelled"
 
     def request(self, rid: int) -> RouterRequest:
         return self._rr(rid)
@@ -293,39 +314,40 @@ class Router:
         """One control-plane iteration: step live replicas (containing
         crashes), check heartbeats, sync observed tokens, retire
         finished drains, publish gauges."""
-        now = self._clock()
-        t0 = time.perf_counter()
-        for rep in self.replicas:
-            if rep.state not in (HEALTHY, DRAINING):
-                continue
-            eng = rep.engine
-            if eng.sched.idle():
-                # legitimately idle: the call itself proves liveness
-                self._hb.beat(rep.idx, now=now)
-                continue
-            try:
-                eng.step()
-            except Exception as exc:   # noqa: BLE001 — contain the death
-                self._declare_dead(rep, "crash", now, error=repr(exc))
-                continue
-            # progress-based: a hung step returns fine but never
-            # advances `beat`, so this beat does not register
-            self._hb.beat(rep.idx, progress=eng.beat, now=now)
-        for rep in self.replicas:
-            if (rep.state in (HEALTHY, DRAINING)
-                    and self._hb.age_ms(rep.idx, now=now)
-                    > self.config.heartbeat_timeout_ms):
-                self._declare_dead(rep, "heartbeat", now)
-        self._sync(now)
-        for rep in self.replicas:
-            if rep.state == DRAINING and rep.engine.sched.idle():
-                rep.state = DRAINED
-                self._hb.forget(rep.idx)
-        telemetry.gauge("serve.router.replicas_healthy").set(
-            sum(1 for r in self.replicas if r.state == HEALTHY))
-        ms = (time.perf_counter() - t0) * 1e3
-        self._step_ms = (ms if self._step_ms == 0.0
-                         else 0.8 * self._step_ms + 0.2 * ms)
+        with self._lock:
+            now = self._clock()
+            t0 = time.perf_counter()
+            for rep in self.replicas:
+                if rep.state not in (HEALTHY, DRAINING):
+                    continue
+                eng = rep.engine
+                if eng.sched.idle():
+                    # legitimately idle: the call itself proves liveness
+                    self._hb.beat(rep.idx, now=now)
+                    continue
+                try:
+                    eng.step()
+                except Exception as exc:  # noqa: BLE001 — contain death
+                    self._declare_dead(rep, "crash", now, error=repr(exc))
+                    continue
+                # progress-based: a hung step returns fine but never
+                # advances `beat`, so this beat does not register
+                self._hb.beat(rep.idx, progress=eng.beat, now=now)
+            for rep in self.replicas:
+                if (rep.state in (HEALTHY, DRAINING)
+                        and self._hb.age_ms(rep.idx, now=now)
+                        > self.config.heartbeat_timeout_ms):
+                    self._declare_dead(rep, "heartbeat", now)
+            self._sync(now)
+            for rep in self.replicas:
+                if rep.state == DRAINING and rep.engine.sched.idle():
+                    rep.state = DRAINED
+                    self._hb.forget(rep.idx)
+            telemetry.gauge("serve.router.replicas_healthy").set(
+                sum(1 for r in self.replicas if r.state == HEALTHY))
+            ms = (time.perf_counter() - t0) * 1e3
+            self._step_ms = (ms if self._step_ms == 0.0
+                             else 0.8 * self._step_ms + 0.2 * ms)
 
     def _sync(self, now: float) -> None:
         """Pull every in-flight request's tokens into the router's own
@@ -413,35 +435,37 @@ class Router:
         requests finish in place, its still-QUEUED ones migrate to
         survivors immediately (no point waiting behind a closing
         door)."""
-        rep = self.replicas[idx]
-        if rep.state != HEALTHY:
-            raise MXNetError(
-                f"replica {idx} is {rep.state}; only a healthy replica "
-                "drains")
-        rep.state = DRAINING
-        telemetry.counter("serve.router.drains").inc()
-        for rr in self._requests.values():
-            if rr.done() or rr.replica is not rep:
-                continue
-            ereq = rep.engine.requests.get(rr.engine_rid)
-            if ereq is None or ereq.state != QUEUED:
-                continue
-            # silent engine-side cancel: the router-level request lives
-            # on and re-homes with its original seed and submit time
-            rep.engine.sched.cancel(ereq)
-            rr.replica = None
-            rr.engine_rid = None
-            target = self._pick()
-            if target is None:
-                self._fail(rr, "error")
-                continue
-            rr.engine_rid = target.engine.adopt(
-                rr.prompt, rr.tokens,
-                max_new_tokens=rr.max_new_tokens,
-                temperature=rr.temperature, top_k=rr.top_k,
-                slo_ms=rr.slo_ms, eos_id=rr.eos_id, seed=rr.seed,
-                deadline_ms=rr.deadline_ms, submit_t=rr.submit_t)
-            rr.replica = target
+        with self._lock:
+            rep = self.replicas[idx]
+            if rep.state != HEALTHY:
+                raise MXNetError(
+                    f"replica {idx} is {rep.state}; only a healthy "
+                    "replica drains")
+            rep.state = DRAINING
+            telemetry.counter("serve.router.drains").inc()
+            for rr in self._requests.values():
+                if rr.done() or rr.replica is not rep:
+                    continue
+                ereq = rep.engine.requests.get(rr.engine_rid)
+                if ereq is None or ereq.state != QUEUED:
+                    continue
+                # silent engine-side cancel: the router-level request
+                # lives on and re-homes with its original seed and
+                # submit time
+                rep.engine.sched.cancel(ereq)
+                rr.replica = None
+                rr.engine_rid = None
+                target = self._pick()
+                if target is None:
+                    self._fail(rr, "error")
+                    continue
+                rr.engine_rid = target.engine.adopt(
+                    rr.prompt, rr.tokens,
+                    max_new_tokens=rr.max_new_tokens,
+                    temperature=rr.temperature, top_k=rr.top_k,
+                    slo_ms=rr.slo_ms, eos_id=rr.eos_id, seed=rr.seed,
+                    deadline_ms=rr.deadline_ms, submit_t=rr.submit_t)
+                rr.replica = target
 
     # -- rolling weight swap -----------------------------------------------
 
@@ -512,19 +536,24 @@ class Router:
                         raise MXNetError(
                             f"rolling_swap: replica {rep.idx} still "
                             f"draining after {max_steps} steps")
-                if mode == "hot":
-                    rep.engine.swap_weights(params_or_source)
-                else:
-                    old = rep.engine
-                    rep.engine = Engine(
-                        params_or_source,
-                        engine_config or old.config,
-                        chaos=old.chaos or chaos_mod.ChaosSpec({}))
-                    rep.engine.warmup()
-                    telemetry.counter("online.rebuilds").inc()
-                rep.state = HEALTHY
-                rep.death_cause = None
-                self._hb.beat(rep.idx, now=self._clock())
+                # install under the lock: a concurrent step()/submit()
+                # must never observe a half-swapped replica (the drain
+                # wait above deliberately does NOT hold it, so client
+                # threads keep stepping the rest of the fleet)
+                with self._lock:
+                    if mode == "hot":
+                        rep.engine.swap_weights(params_or_source)
+                    else:
+                        old = rep.engine
+                        rep.engine = Engine(
+                            params_or_source,
+                            engine_config or old.config,
+                            chaos=old.chaos or chaos_mod.ChaosSpec({}))
+                        rep.engine.warmup()
+                        telemetry.counter("online.rebuilds").inc()
+                    rep.state = HEALTHY
+                    rep.death_cause = None
+                    self._hb.beat(rep.idx, now=self._clock())
                 ms = (time.perf_counter() - t0) * 1e3
                 swap_ms.append(ms)
                 telemetry.histogram("online.swap_ms").observe(ms)
@@ -574,6 +603,10 @@ class Router:
     # -- introspection -----------------------------------------------------
 
     def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return self._stats_locked()
+
+    def _stats_locked(self) -> Dict[str, Any]:
         return {
             "replicas": [{
                 "idx": rep.idx, "state": rep.state,

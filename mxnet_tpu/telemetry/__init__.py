@@ -140,9 +140,10 @@ def reset_for_tests() -> None:
     off, env re-read on next use."""
     global _ready, _emitter
     _registry.reset()
-    _flight._ring.clear()
+    with _flight._lock:
+        _flight._ring.clear()
+        _flight.dump_count = 0
     _flight.dump_dir = None
-    _flight.dump_count = 0
     _costs.clear()
     tracing.configure(None)
     tracing.clear()

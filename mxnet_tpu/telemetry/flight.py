@@ -36,10 +36,10 @@ DEFAULT_CAPACITY = 256
 
 class FlightRecorder:
     def __init__(self, capacity: int = DEFAULT_CAPACITY):
-        self._ring: deque = deque(maxlen=int(capacity))
+        self._ring: deque = deque(maxlen=int(capacity))  # shared: guarded_by=_lock
         self._lock = threading.Lock()
         self.dump_dir: Optional[str] = None  # None = auto-dumps off
-        self.dump_count = 0
+        self.dump_count = 0                  # shared: guarded_by=_lock
         self.last_dump: Optional[str] = None
 
     @property
@@ -51,13 +51,19 @@ class FlightRecorder:
             self._ring = deque(self._ring, maxlen=max(1, int(capacity)))
 
     def record(self, rec: Dict[str, Any]) -> None:
-        self._ring.append(rec)
+        # trainer, watchdog, and serve threads all append; an unlocked
+        # deque append is atomic but racing dump()'s list() copy tears
+        # the snapshot mid-iteration
+        with self._lock:
+            self._ring.append(rec)
 
     def records(self) -> list:
-        return list(self._ring)
+        with self._lock:
+            return list(self._ring)
 
     def __len__(self) -> int:
-        return len(self._ring)
+        with self._lock:
+            return len(self._ring)
 
     def dump(self, reason: str, path: Optional[str] = None,
              metrics: Optional[Dict[str, float]] = None,
@@ -69,11 +75,13 @@ class FlightRecorder:
         if path is None:
             if self.dump_dir is None:
                 return None
-            self.dump_count += 1
+            with self._lock:
+                self.dump_count += 1
+                seq = self.dump_count
             path = os.path.join(
                 self.dump_dir,
                 f"flightrec-{reason}-p{os.getpid()}"
-                f"-{self.dump_count}.json")
+                f"-{seq}.json")
         doc = {
             "reason": reason,
             "time": time.time(),
@@ -98,7 +106,8 @@ class FlightRecorder:
         except OSError as e:
             log.warning("flight recorder: dump %r failed: %s", reason, e)
             return None
-        self.last_dump = path
+        with self._lock:
+            self.last_dump = path
         log.warning("flight recorder: dumped %d step records to %s "
                     "(reason: %s)", len(doc["records"]), path, reason)
         return path

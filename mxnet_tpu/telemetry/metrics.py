@@ -102,7 +102,8 @@ class Metric:
         self.help = help
         self._buckets = tuple(buckets)
         self._lock = lock or threading.Lock()
-        self._series: Dict[Tuple[Tuple[str, str], ...], Any] = {}
+        # series creation locks; cell updates are lock-free by design
+        self._series: Dict[Tuple[Tuple[str, str], ...], Any] = {}  # shared: guarded_by=_lock
         self._default = self._new_series()
         self._series[()] = self._default
 
@@ -141,7 +142,9 @@ class Registry:
     """Process-wide metric namespace with get-or-create semantics."""
 
     def __init__(self):
-        self._metrics: Dict[str, Metric] = {}
+        # creation is guarded; reads ride the documented lock-free
+        # fast path (module docstring)
+        self._metrics: Dict[str, Metric] = {}   # shared: guarded_by=_lock
         self._lock = threading.Lock()
 
     def _get(self, name: str, kind: str, help: str = "",
@@ -283,7 +286,7 @@ class JsonlEmitter:
     def __init__(self, path: str, interval: float = 10.0):
         self.path = path
         self.interval = float(interval)
-        self._last = 0.0
+        self._last = 0.0              # shared: guarded_by=_lock
         self._lock = threading.Lock()
         # truncate-on-open would destroy a restarted run's history;
         # append, and let the reader key on ts/pid
@@ -296,14 +299,20 @@ class JsonlEmitter:
         row.update(rec)
         line = json.dumps(row, default=str)
         with self._lock:
+            # staticcheck: disable=conc.blocking-under-lock -- the lock IS the line serializer: one short append per row, and writers must not interleave
             with open(self.path, "a") as f:
                 f.write(line + "\n")
 
     def maybe_snapshot(self, registry: Registry,
                        force: bool = False) -> bool:
         now = time.monotonic()
-        if not force and now - self._last < self.interval:
-            return False
-        self._last = now
+        # claim the interval under the lock (check-then-set on _last
+        # raced between trainer / ckpt-writer / prefetch threads and
+        # double-emitted snapshots), then emit outside it — emit()
+        # retakes the same non-reentrant lock
+        with self._lock:
+            if not force and now - self._last < self.interval:
+                return False
+            self._last = now
         self.emit("metrics", {"metrics": registry.flat()})
         return True

@@ -1,0 +1,43 @@
+"""Seeded violations: source.unguarded-shared-write, source.daemon-capture."""
+import threading
+
+
+class LossyBuffer:
+    """Declares ``_items`` lock-guarded, then mutates it three ways
+    without the lock."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []          # shared: guarded_by=_lock
+        self._hits = 0            # shared: guarded_by=_lock
+
+    def add_locked(self, x):      # the one correct method
+        with self._lock:
+            self._items.append(x)
+
+    def add_racy(self, x):
+        self._items.append(x)     # BAD: mutator call outside the lock
+
+    def rebind_racy(self):
+        self._items = []          # BAD: rebinds outside the lock
+
+    def index_racy(self, i, x):
+        self._items[i] = x        # BAD: item store outside the lock
+
+    def bump_racy(self):
+        self._hits += 1           # BAD: augmented write outside the lock
+
+
+def spawn_worker(records):
+    """Daemon worker captures ``batch``, which is rebound after the
+    thread starts — the worker races the rebind."""
+    batch = list(records)
+
+    def worker():
+        for r in batch:
+            print(r)
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    batch = []                    # BAD: rebind races the running worker
+    return t

@@ -1,0 +1,120 @@
+"""Seeded concurrency violations for the lockset sanitizer
+(``tools/staticcheck.py races``; rules in docs/static_analysis.md).
+
+Each case is ``fn(audit)`` executed inside its own
+``analysis.audit_threads()`` window; ``expected.json`` (section
+``threads``) pins which ``conc.*`` rule every case must still trigger —
+and that the two negative controls stay silent.  The detector is
+schedule-INSENSITIVE: a data race is two unordered accesses with
+disjoint locksets, so these cases fire on every run even when the
+OS happens to serialize the threads.
+"""
+import threading
+import time
+
+
+def data_race(audit):
+    """Two threads append to a shared list with no lock and no
+    happens-before edge: both must be started before either is joined,
+    otherwise the join would publish the first thread's clock to the
+    second and order them."""
+    shared = []
+    box = type("Box", (), {})()
+    box.items = shared
+    audit.track(box, "items", label="corpus.items")
+
+    def w():
+        for _ in range(10):
+            box.items.append(1)
+
+    t1 = threading.Thread(target=w, name="corpus-race-1")
+    t2 = threading.Thread(target=w, name="corpus-race-2")
+    t1.start()
+    t2.start()
+    t1.join()
+    t2.join()
+
+
+def lock_order(audit):
+    """A and B acquired in opposite orders.  The acquisition graph is
+    deliberately blind to happens-before, so sequential threads still
+    witness the cycle — this run got lucky, the schedule that deadlocks
+    exists."""
+    la = audit.make_lock(label="corpus.A")
+    lb = audit.make_lock(label="corpus.B")
+
+    def ab():
+        with la:
+            with lb:
+                pass
+
+    def ba():
+        with lb:
+            with la:
+                pass
+
+    t1 = threading.Thread(target=ab, name="corpus-order-1")
+    t1.start()
+    t1.join()
+    t2 = threading.Thread(target=ba, name="corpus-order-2")
+    t2.start()
+    t2.join()
+
+
+def blocking(audit):
+    """A real sleep while holding an instrumented lock: every thread
+    that needs the lock stalls behind the sleep."""
+    mu = audit.make_lock(label="corpus.mu")
+    with mu:
+        time.sleep(0.001)
+
+
+def clean_locked(audit):
+    """Negative control: the same shared append, serialized by one
+    common lock — the lockset intersection is never empty."""
+    box = type("Box", (), {})()
+    box.items = []
+    audit.track(box, "items", label="corpus.clean_items")
+    mu = audit.make_lock(label="corpus.clean_mu")
+
+    def w():
+        for _ in range(10):
+            with mu:
+                box.items.append(1)
+
+    t1 = threading.Thread(target=w, name="corpus-clean-1")
+    t2 = threading.Thread(target=w, name="corpus-clean-2")
+    t1.start()
+    t2.start()
+    t1.join()
+    t2.join()
+
+
+def clean_event_publish(audit):
+    """Negative control: a lock-free handoff published through an
+    Event.  set() -> wait() is a real happens-before edge, so the
+    writer's access is ordered before the reader's — benign by
+    construction, not by suppression."""
+    box = type("Box", (), {})()
+    box.val = []
+    audit.track(box, "val", label="corpus.published")
+    ready = threading.Event()
+
+    def writer():
+        box.val.append(1)
+        ready.set()
+
+    t = threading.Thread(target=writer, name="corpus-publish")
+    t.start()
+    ready.wait()
+    box.val.append(2)
+    t.join()
+
+
+CASES = {
+    "data_race": data_race,
+    "lock_order": lock_order,
+    "blocking": blocking,
+    "clean_locked": clean_locked,
+    "clean_event_publish": clean_event_publish,
+}

@@ -76,7 +76,7 @@ def _lm_trainer(**kw):
 def test_every_rule_has_severity_and_description():
     for rule, (sev, desc) in F.RULES.items():
         assert sev in F.SEVERITIES and desc
-        assert rule.split(".")[0] in ("program", "source")
+        assert rule.split(".")[0] in ("program", "source", "conc")
 
 
 def test_finding_defaults_severity_from_rule():

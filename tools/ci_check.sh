@@ -33,6 +33,31 @@ if [[ "$mode" != "--tests-only" ]]; then
 fi
 
 if [[ "$mode" != "--tests-only" ]]; then
+    # lockset race detector over the real threaded control plane +
+    # the seeded conc.* corpus (docs/static_analysis.md §Concurrency)
+    echo "== staticcheck races (lockset sanitizer) =="
+    python tools/staticcheck.py races
+    rc=$?
+    if [[ $rc -ne 0 ]]; then
+        echo "ci_check: staticcheck races FAILED (rc=$rc)" >&2
+        exit "$rc"
+    fi
+fi
+
+if [[ "$mode" != "--tests-only" ]]; then
+    # deterministic schedule fuzzer: MXNET_TPU_CONC_SCHEDULES seeded
+    # interleavings per hot concurrent scenario, byte-identity asserted
+    # under every one; failures print a replayable (scenario, seed)
+    echo "== staticcheck schedules (deterministic fuzzer) =="
+    python tools/staticcheck.py schedules
+    rc=$?
+    if [[ $rc -ne 0 ]]; then
+        echo "ci_check: staticcheck schedules FAILED (rc=$rc)" >&2
+        exit "$rc"
+    fi
+fi
+
+if [[ "$mode" != "--tests-only" ]]; then
     # quick end-to-end check that the telemetry seams still emit: a
     # tiny instrumented train must produce a valid Perfetto trace and
     # a metrics stream --diff-metrics can read (docs/observability.md)

@@ -34,6 +34,11 @@ sentinel counters, collective wire bytes, compile-cache hits, derived
 MFU/bandwidth gauges), plus any tee'd audit rows and per-epoch
 resilience rows — the one-command answer to "what changed between
 these two runs" (docs/observability.md).
+
+``--diff-staticcheck A B`` diffs two ``staticcheck <cmd> --json``
+reports keyed by ``(rule, location)``: any unsuppressed non-info
+finding new in B is a regression (stderr + exit 1); findings present
+only in A are listed as resolved (docs/static_analysis.md).
 """
 import argparse
 import json
@@ -489,6 +494,36 @@ def diff_metrics(path_a, path_b):
     return 0
 
 
+def diff_staticcheck(path_a, path_b):
+    """Diff two ``staticcheck <cmd> --json`` reports keyed by
+    ``(rule, location)``.  Findings that are new in B (and not
+    suppressed) are regressions — printed to stderr, exit 1; findings
+    present only in A are listed as resolved.  ``info``-severity
+    findings are observational and never regress the diff."""
+    def load(path):
+        with open(path) as f:
+            doc = json.load(f)
+        out = {}
+        for fd in doc.get("findings", []):
+            if fd.get("suppressed") or fd.get("severity") == "info":
+                continue
+            loc = fd.get("program") or (
+                f"{fd.get('path', '')}:{fd.get('line', 0)}")
+            out[(fd["rule"], loc)] = fd
+        return out
+    a, b = load(path_a), load(path_b)
+    resolved = sorted(set(a) - set(b))
+    new = sorted(set(b) - set(a))
+    print(f"staticcheck diff: {len(a)} -> {len(b)} findings "
+          f"({len(new)} new, {len(resolved)} resolved)")
+    for rule, loc in resolved:
+        print(f"resolved: {loc}: [{rule}]")
+    for rule, loc in new:
+        print(f"REGRESSED: {loc}: [{rule}] "
+              f"{b[(rule, loc)].get('message', '')}", file=sys.stderr)
+    return 1 if new else 0
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("logfile", nargs="?", help="default: stdin")
@@ -514,7 +549,14 @@ def main():
                     "(BENCH_r10.json): exits 1 if tokens/s regressed "
                     "beyond the 5%% noise floor or p99 per-token "
                     "latency grew more than 10%%, B relative to A")
+    ap.add_argument("--diff-staticcheck", nargs=2, metavar=("A", "B"),
+                    help="diff two `staticcheck <cmd> --json` reports "
+                    "keyed by (rule, location): exits 1 on any new "
+                    "unsuppressed non-info finding in B, lists findings "
+                    "resolved since A")
     args = ap.parse_args()
+    if args.diff_staticcheck:
+        return diff_staticcheck(*args.diff_staticcheck)
     if args.diff_serve:
         return diff_serve(*args.diff_serve)
     if args.diff_profile:

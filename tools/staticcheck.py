@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """Static analysis gate for mxnet_tpu (docs/static_analysis.md).
 
-Three subcommands:
+Five subcommands:
 
 - ``lint``  — AST linter over the repo sources (host syncs in traced
   code, nondeterminism, env-var doc drift, donated-buffer reads).
@@ -15,6 +15,18 @@ Three subcommands:
   violation in ``tests/golden/staticcheck/`` must still be detected
   (rule-regression coverage), with the corpus' negative control
   staying silent.
+- ``races`` — runtime lockset sanitizer (``conc.*`` rules): drive the
+  real threaded control-plane paths (telemetry record/dump, the async
+  checkpoint writer, the device prefetcher) under
+  ``analysis.audit_threads(instrument_framework=True)``, then re-run
+  every seeded violation in ``tests/golden/staticcheck/bad_threads.py``
+  (detector-regression coverage, with clean negative controls).
+- ``schedules`` — deterministic schedule fuzzer: N seeded
+  interleavings per hot concurrent scenario
+  (``mxnet_tpu/analysis/schedules.py``), each asserting byte-identity
+  invariants.  ``MXNET_TPU_CONC_SCHEDULES`` / ``MXNET_TPU_CONC_SEED``
+  (or ``--n`` / ``--seed``) set the sweep; a failure prints the
+  replayable ``(scenario, seed)`` pair.
 
 Exit codes: 0 clean, 1 findings / missed expectations, 2 internal
 error.  ``--json`` emits the machine-readable report (schema in
@@ -182,6 +194,114 @@ def _check_corpus(analysis):
 
 
 # ----------------------------------------------------------------------
+# Concurrency: live lockset run + threads corpus (races), fuzzer sweep
+# ----------------------------------------------------------------------
+
+def _races_live(analysis):
+    """Drive the real threaded control-plane paths under the lockset
+    sanitizer and return the analyzed report.  This is the repo-wide
+    "no data races in what actually runs" check: telemetry step
+    recording + mid-append flight dumps, the JSONL emitter, the async
+    checkpoint writer racing in-place mutation, and the device
+    prefetch worker."""
+    import logging
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    from mxnet_tpu import telemetry
+
+    report = analysis.Report(mode="races")
+    telemetry.reset_for_tests()
+    # the mid-append dumps are the point of the exercise, not news
+    logging.getLogger("mxnet_tpu.telemetry.flight").setLevel(logging.ERROR)
+    with tempfile.TemporaryDirectory(prefix="mxtpu_races_") as td:
+        telemetry.configure(metrics_file=os.path.join(td, "m.jsonl"),
+                            metrics_interval=0.0)
+        with analysis.audit_threads(report=report,
+                                    instrument_framework=True) as audit:
+            def ticker(tag):
+                for i in range(30):
+                    telemetry.record_step({"step": i, "tag": tag})
+                    telemetry.counter(f"races.{tag}").inc()
+
+            ts = [threading.Thread(target=ticker, args=(k,),
+                                   name=f"races-tick-{k}")
+                  for k in ("a", "b")]
+            for t in ts:
+                t.start()
+            for i in range(5):   # dumps race the appending tickers
+                telemetry.dump_flight("races",
+                                      path=os.path.join(td, f"f{i}.json"))
+            for t in ts:
+                t.join()
+            telemetry.flush_metrics()
+
+            from mxnet_tpu.checkpoint.manager import CheckpointManager
+            arrays = {"w": np.arange(32, dtype=np.float32)}
+            mgr = CheckpointManager(os.path.join(td, "ckpt"), keep_last=3,
+                                    async_write=True)
+            for s in range(2):
+                mgr.save(s, arrays)
+                arrays["w"] += 1.0   # in-place "train step" mutation
+            mgr.wait_until_finished()
+            mgr.close()
+
+            from mxnet_tpu.io import DevicePrefetchIter, NDArrayIter
+            it = DevicePrefetchIter(
+                NDArrayIter(np.zeros((16, 4), dtype=np.float32),
+                            batch_size=4), depth=2)
+            for _ in it:
+                pass
+            it.close()
+    telemetry.configure(metrics_file="")   # drop the tmpdir emitter
+    races = report.metrics.get("races", {}).get("races_found", 0)
+    telemetry.counter("staticcheck.races_found").inc(races)
+    return report
+
+
+def _load_threads_corpus():
+    path = os.path.join(CORPUS_DIR, "bad_threads.py")
+    spec = importlib.util.spec_from_file_location(
+        "mxtpu_staticcheck_threads", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _check_threads_corpus(analysis):
+    """Re-run every seeded concurrency violation: each case drives real
+    threads under its own audit window and must still produce its rule
+    (negative controls must stay silent)."""
+    with open(os.path.join(CORPUS_DIR, "expected.json")) as f:
+        expected = json.load(f)
+    mod = _load_threads_corpus()
+    failures = []
+    per_case = {}
+    for e in expected.get("threads", []):
+        name = e["case"]
+        fn = mod.CASES[name]
+        with analysis.audit_threads() as audit:
+            fn(audit)
+        rules = sorted({f.rule for f in audit.report.findings
+                        if not f.suppressed})
+        per_case[name] = rules
+        if e.get("clean"):
+            if rules:
+                failures.append(f"threads corpus: negative control "
+                                f"{name} triggered {rules}")
+            continue
+        got = sum(1 for f in audit.report.findings
+                  if f.rule == e["rule"] and not f.suppressed)
+        if got < e.get("min_count", 1):
+            failures.append(f"threads corpus: {e['rule']} did not fire "
+                            f"on {name}")
+    details = {"cases": per_case, "failures": failures}
+    return not failures, failures, details
+
+
+# ----------------------------------------------------------------------
 # CLI
 # ----------------------------------------------------------------------
 
@@ -190,7 +310,8 @@ def main(argv=None):
         prog="staticcheck",
         description="jaxpr/HLO program auditor + repo linter "
                     "(docs/static_analysis.md)")
-    ap.add_argument("command", choices=("lint", "audit", "gate"))
+    ap.add_argument("command",
+                    choices=("lint", "audit", "gate", "races", "schedules"))
     ap.add_argument("--json", action="store_true",
                     help="emit the machine-readable report")
     ap.add_argument("--suppress", action="append", default=[],
@@ -204,6 +325,14 @@ def main(argv=None):
                          f"(default {','.join(AUDIT_NETWORKS)})")
     ap.add_argument("--programs", default="train,train_acc",
                     help="trainer program kinds to audit")
+    ap.add_argument("--scenarios", default="",
+                    help="comma-separated schedule scenarios "
+                         "(default: all; see analysis/schedules.py)")
+    ap.add_argument("--n", type=int, default=None,
+                    help="interleavings per scenario "
+                         "(default MXNET_TPU_CONC_SCHEDULES=50)")
+    ap.add_argument("--seed", type=int, default=None,
+                    help="base fuzzer seed (default MXNET_TPU_CONC_SEED=0)")
     args = ap.parse_args(argv)
 
     analysis = _repo_import()
@@ -212,11 +341,36 @@ def main(argv=None):
 
     out = {"schema": analysis.SCHEMA_VERSION, "command": args.command}
     extra_lines = []
+    corpus_ok = True
+    corpus_failures = []
     if args.command == "lint":
         report = analysis.lint_paths(args.root)
     elif args.command == "audit":
         report = _run_audit(analysis, networks, programs)
         extra_lines = _hbm_lines(report)
+    elif args.command == "races":
+        report = _races_live(analysis)
+        corpus_ok, corpus_failures, corpus_details = \
+            _check_threads_corpus(analysis)
+        out["corpus"] = corpus_details
+        m = report.metrics.get("races", {})
+        extra_lines = [f"races: live run: {m.get('events', 0)} events, "
+                       f"{m.get('threads', 0)} threads, "
+                       f"{m.get('locations', 0)} tracked locations, "
+                       f"{m.get('races_found', 0)} race(s)"]
+    elif args.command == "schedules":
+        report = analysis.Report(mode="schedules")
+        scenarios = [s for s in args.scenarios.split(",") if s] or None
+        res = analysis.run_schedules(
+            scenarios=scenarios, n=args.n, seed=args.seed,
+            log=None if args.json else print)
+        out["schedules"] = res
+        corpus_ok = res["ok"]
+        corpus_failures = [
+            f"schedules: {f['scenario']} failed at seed {f['seed']} "
+            f"({f['error']}) — replay: staticcheck schedules "
+            f"--scenarios {f['scenario']} --n 1 --seed {f['seed']}"
+            for f in res["failures"]]
     else:  # gate
         report = analysis.Report(mode="gate")
         report.merge(analysis.lint_paths(args.root))
@@ -227,9 +381,7 @@ def main(argv=None):
         out["corpus"] = corpus_details
 
     analysis.apply_cli(report.findings, args.suppress)
-    ok = report.clean
-    if args.command == "gate":
-        ok = ok and corpus_ok
+    ok = report.clean and corpus_ok
 
     out.update(report.to_dict())
     out["ok"] = ok
@@ -239,10 +391,10 @@ def main(argv=None):
         print(report.format_text(show_suppressed=args.show_suppressed))
         for line in extra_lines:
             print(line)
-        if args.command == "gate":
-            for fail in corpus_failures:
-                print(fail)
-            print(f"gate: {'PASS' if ok else 'FAIL'}")
+        for fail in corpus_failures:
+            print(fail)
+        if args.command in ("gate", "races", "schedules"):
+            print(f"{args.command}: {'PASS' if ok else 'FAIL'}")
     return 0 if ok else 1
 
 
