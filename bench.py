@@ -1187,6 +1187,117 @@ def bench_serve(args):
     return rows
 
 
+def bench_elastic(args):
+    """--elastic: the live mesh-resize cost (docs/elastic.md, r14).
+
+    Drives an in-process :class:`ElasticTrainer` (ZeRO-sharded SGD on
+    the 8-virtual-device CPU mesh) through the 8 -> 4 -> 8 round-trip:
+    4 steps, shrink, 4 steps, grow back, 4 steps, with the shrink
+    target pre-warmed.  One row per resize records the wall-clock
+    training pause (drain + snapshot + reshard restore + AOT attach),
+    steps lost (must be 0: drain-then-snapshot is exact) and retraces
+    (must be 0: the warm restart is the whole point).  A summary row
+    pins the degradation guarantee: the post-shrink segment is BITWISE
+    identical to a fresh trainer launched on the 4-device mesh from the
+    same snapshot.  Results land in ``BENCH_r14.json``;
+    ``tools/parse_log.py --diff-elastic`` gates two of these reports.
+    """
+    import shutil
+    import tempfile
+
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu.checkpoint import CheckpointManager
+    from mxnet_tpu.parallel import ElasticTrainer, ShardedTrainer, make_mesh
+
+    def mlp():
+        d = mx.symbol.Variable("data")
+        f1 = mx.symbol.FullyConnected(data=d, name="fc1", num_hidden=64)
+        a = mx.symbol.Activation(data=f1, name="r", act_type="relu")
+        f2 = mx.symbol.FullyConnected(data=a, name="fc2", num_hidden=10)
+        return mx.symbol.SoftmaxOutput(data=f2, name="softmax")
+
+    def batch(i):
+        rs = np.random.RandomState(100 + i)
+        return {"data": (rs.randn(64, 32) * 0.1).astype(np.float32),
+                "softmax_label": (rs.rand(64) * 10).astype(np.float32)}
+
+    dev = jax.devices()[0].device_kind
+    root = tempfile.mkdtemp(prefix="mxnet-tpu-elastic-bench-")
+    mgr = CheckpointManager(os.path.join(root, "ckpt"))
+    mx.random.seed(7)
+    et = ElasticTrainer(mlp(), optimizer="sgd",
+                        optimizer_params={"learning_rate": 0.1},
+                        manager=mgr, prewarm=False,
+                        trainer_kwargs={"shard_optimizer": True})
+    et.bind({"data": (64, 32)}, {"softmax_label": (64,)})
+    for i in range(4):
+        et.step(batch(i))
+    et.prewarm([4], wait=True)
+    et.resize(4)
+    shrunk = [np.asarray(jax.device_get(et.step(batch(i))[0]))
+              for i in range(4, 8)]
+    et.resize(8)
+    for i in range(8, 12):
+        et.step(batch(i))
+
+    # degradation guarantee: the post-shrink segment must be bitwise
+    # what a fresh 4-device relaunch from the shrink snapshot computes
+    mx.random.seed(99)
+    ref = ShardedTrainer(mlp(), optimizer="sgd",
+                         optimizer_params={"learning_rate": 0.1},
+                         mesh=make_mesh({"data": 4}, jax.devices()[:4]),
+                         shard_optimizer=True)
+    ref.bind({"data": (64, 32)}, {"softmax_label": (64,)})
+    ref.restore_state(mgr, step=4)  # the shrink snapshot, not the latest
+    bitwise = all(
+        np.array_equal(mine,
+                       np.asarray(jax.device_get(ref.step(batch(i))[0])))
+        for i, mine in zip(range(4, 8), shrunk))
+
+    rows = []
+    for r in et.resizes:
+        rows.append(_emit_row({
+            "metric": f"elastic resize {r['direction']} "
+                      f"{r['from_devices']}->{r['to_devices']} ({dev})",
+            "value": round(r["pause_ms"], 2),
+            "unit": "ms training pause (drain+snapshot+restore+attach)",
+            "vs_baseline": None,
+            "direction": r["direction"],
+            "drain_ms": round(r["drain_ms"], 2),
+            "restore_ms": round(r["restore_ms"], 2),
+            "pause_ms": round(r["pause_ms"], 2),
+            "steps_lost": r["steps_lost"],
+            "retraces": r["retraces"],
+            "n_devices": len(jax.devices()),
+        }))
+    rows.append(_emit_row({
+        "metric": f"elastic 8->4->8 round-trip ({dev})",
+        "value": sum(r["steps_lost"] for r in et.resizes),
+        "unit": "steps lost across both resizes",
+        "vs_baseline": None,
+        "resizes": len(et.resizes),
+        "num_update": et.num_update,
+        "retraces": sum(r["retraces"] for r in et.resizes),
+        "bitwise_vs_fresh_mesh": bool(bitwise),
+        "target": "0 steps lost, 0 retraces, post-shrink segment "
+                  "bitwise-identical to a fresh 4-device run from the "
+                  "same snapshot",
+        "pass": bool(sum(r["steps_lost"] for r in et.resizes) == 0
+                     and sum(r["retraces"] for r in et.resizes) == 0
+                     and bitwise and et.num_update == 12),
+        "n_devices": len(jax.devices()),
+    }))
+    mgr.close()
+    shutil.rmtree(root, ignore_errors=True)
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "BENCH_r14.json")
+    with open(out, "w") as f:
+        json.dump(rows, f, indent=2)
+        f.write("\n")
+    return rows
+
+
 def bench_compile(args):
     """--compile: cold-start elimination (docs/perf.md r7).
 
@@ -1461,13 +1572,20 @@ def main():
                     "(Router.rolling_swap of a null update mid-run; "
                     "per-replica swap latency, tokens/s dip, streams "
                     "byte-identical, zero retraces) -> BENCH_r13.json")
+    ap.add_argument("--elastic", action="store_true",
+                    help="elastic-training scenario (docs/elastic.md): "
+                    "in-process 8->4->8 live mesh resize (drain + "
+                    "snapshot + reshard restore + AOT warm attach); "
+                    "per-resize pause ms, steps lost, retraces, bitwise "
+                    "degradation check -> BENCH_r14.json")
     args = ap.parse_args()
     if args.compute_dtype == "none":
         args.compute_dtype = None
     if args.grad_compression == "none":
         args.grad_compression = None
 
-    if args.compile or args.resilience or args.audit or args.serve:
+    if (args.compile or args.resilience or args.audit or args.serve
+            or args.elastic):
         # acceptance config is the 8-virtual-device CPU mesh; only set
         # when the caller hasn't picked a platform (jax is imported
         # lazily, so this is early enough)
@@ -1480,6 +1598,8 @@ def main():
             bench_audit(args)
         elif args.serve:
             bench_serve(args)
+        elif args.elastic:
+            bench_elastic(args)
         else:
             bench_resilience(args)
         return 0

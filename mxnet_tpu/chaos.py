@@ -27,6 +27,17 @@ the engine's in-graph finite guard must catch it).  With multiple
 router replicas, ``MXNET_TPU_CHAOS_REPLICA`` picks which replica the
 spec applies to (default 0).
 
+Elastic-training kinds (consumed by the ``launch_local`` membership
+harness — ``tests/elastic_train_worker.py`` / ``tools/elastic_smoke.py``
+— at exact *trainer* step values carried in the membership view, see
+docs/elastic.md): ``worker_kill`` (the targeted worker SIGKILLs itself
+once the trainer's published progress reaches the index — the scheduler
+sees connection loss and bumps the membership epoch) and ``partition``
+(the targeted worker stops heartbeating — the scheduler's expiry sweep
+fences it out; on resuming beats it observes its own expulsion and must
+exit rather than keep computing).  ``MXNET_TPU_CHAOS_WORKER`` picks the
+targeted worker id (default 1, never the rank-0 trainer).
+
 ``flip_byte`` / ``corrupt_record`` corrupt RecordIO pack files on disk
 for the tolerant-reader tests.
 """
@@ -43,6 +54,7 @@ _LOGGER = logging.getLogger(__name__)
 
 KINDS = ("nan", "overflow", "crash")
 SERVE_KINDS = ("serve_crash", "serve_hang", "serve_poison_logits")
+ELASTIC_KINDS = ("worker_kill", "partition")
 
 OVERFLOW_VALUE = 1e30  # squares past f32 max, flushes f16/bf16 to inf
 
@@ -53,10 +65,11 @@ class ChaosError(RuntimeError):
 
 class ChaosSpec(object):
     def __init__(self, points: Dict[str, Set[int]]):
+        known = KINDS + SERVE_KINDS + ELASTIC_KINDS
         for kind in points:
-            if kind not in KINDS + SERVE_KINDS:
+            if kind not in known:
                 raise ValueError("unknown chaos kind %r (know %s)"
-                                 % (kind, ", ".join(KINDS + SERVE_KINDS)))
+                                 % (kind, ", ".join(known)))
         self.points = {k: set(v) for k, v in points.items() if v}
 
     def __bool__(self) -> bool:
@@ -102,10 +115,29 @@ def serve_from_env() -> Optional[ChaosSpec]:
     return ChaosSpec(points) if points else None
 
 
+def elastic_from_env() -> Optional[ChaosSpec]:
+    """The elastic-training slice of ``MXNET_TPU_CHAOS`` (``worker_kill``
+    / ``partition`` kinds only), or ``None`` — same slicing contract as
+    :func:`serve_from_env`, so a mixed spec feeds every consumer."""
+    spec = from_env()
+    if spec is None:
+        return None
+    points = {k: v for k, v in spec.points.items() if k in ELASTIC_KINDS}
+    return ChaosSpec(points) if points else None
+
+
 def chaos_replica() -> int:
     """Which router replica ``MXNET_TPU_CHAOS`` targets (default 0)."""
     raw = os.environ.get("MXNET_TPU_CHAOS_REPLICA", "").strip()
     return int(raw) if raw else 0
+
+
+def chaos_worker() -> int:
+    """Which launch_local worker id the elastic kinds target (default 1
+    — worker 0 is the trainer and killing it is a different failure
+    class: the SIGTERM preemption path, not a membership change)."""
+    raw = os.environ.get("MXNET_TPU_CHAOS_WORKER", "").strip()
+    return int(raw) if raw else 1
 
 
 def _poison_array(arr, value: float):

@@ -22,6 +22,7 @@ from .mesh import (DATA_AXIS, EXPERT_AXIS, MODEL_AXIS, PIPE_AXIS, SEQ_AXIS,
                    default_mesh, make_mesh, param_sharding, replicated)
 from .collectives import allreduce_mean, allreduce_sum
 from .trainer import ShardedTrainer, ShardingRules, megatron_rules
+from .elastic import ElasticTrainer, default_mesh_size, pow2_floor, wire_watchdog
 from .ring_attention import local_attention, ring_attention, ring_self_attention
 from .moe import load_balance_loss, moe_ffn, moe_ffn_ep, switch_ffn
 from .pipeline import pipeline_apply
@@ -35,6 +36,7 @@ __all__ = [
     "batch_sharding", "param_sharding", "replicated",
     "allreduce_sum", "allreduce_mean",
     "ShardedTrainer", "ShardingRules", "megatron_rules",
+    "ElasticTrainer", "default_mesh_size", "pow2_floor", "wire_watchdog",
     "ring_attention", "ring_self_attention", "local_attention",
     "switch_ffn", "moe_ffn", "moe_ffn_ep", "load_balance_loss", "pipeline_apply",
     "PipelineTrainer", "SpmdPipelineTrainer",

@@ -31,11 +31,12 @@ from __future__ import annotations
 import atexit
 import os
 import pickle
+import random
 import socket
 import struct
 import threading
 import time
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -43,8 +44,8 @@ from ..base import MXNetError
 from ..kvstore import KVStore, _value_list
 from ..ndarray import NDArray, array as nd_array
 
-__all__ = ["DistKVStore", "run_server", "run_scheduler", "role_from_env",
-           "BIGARRAY_BOUND"]
+__all__ = ["DistKVStore", "MembershipClient", "run_server", "run_scheduler",
+           "role_from_env", "BIGARRAY_BOUND"]
 
 # reference env: MXNET_KVSTORE_BIGARRAY_BOUND (kvstore_dist.h:243-266)
 BIGARRAY_BOUND = int(os.environ.get("MXNET_KVSTORE_BIGARRAY_BOUND", 1 << 20))
@@ -151,10 +152,32 @@ def role_from_env() -> Dict[str, Any]:
 # Scheduler: rendezvous + worker barrier (the ps-lite Postoffice analog)
 # ---------------------------------------------------------------------------
 
+def _elastic_expiry_ms() -> int:
+    raw = os.environ.get("MXNET_TPU_ELASTIC_EXPIRY_MS", "").strip()
+    return int(raw) if raw else 10000
+
+
+def _elastic_heartbeat_ms() -> int:
+    raw = os.environ.get("MXNET_TPU_ELASTIC_HEARTBEAT_MS", "").strip()
+    return int(raw) if raw else 1000
+
+
 def run_scheduler(cfg: Optional[Dict[str, Any]] = None) -> None:
     """Blocking scheduler loop.  Servers register their listen addresses;
     workers register and receive (rank, server table); ``barrier`` releases
-    when every worker arrives (``kvstore.h:232`` Barrier semantics)."""
+    when every worker arrives (``kvstore.h:232`` Barrier semantics).
+
+    The scheduler doubles as the **membership/rendezvous coordinator**
+    for elastic training (docs/elastic.md): ``mjoin``/``mleave``/
+    ``mbeat``/``mdead``/``mview`` messages maintain an epoch-numbered
+    membership view — every change (join, graceful leave, reported
+    death, heartbeat expiry past ``MXNET_TPU_ELASTIC_EXPIRY_MS``, or
+    connection loss) bumps the epoch, so one integer compare tells a
+    trainer whether the world changed.  Views travel in every ``mbeat``
+    reply (request/reply only — no unsolicited pushes racing the wire).
+    A membership-only run ends when every ever-joined member has left;
+    the PS tier's stop counting is unchanged and both conditions must
+    hold when both tiers are in use."""
     cfg = cfg or role_from_env()
     lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
     lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -166,6 +189,57 @@ def run_scheduler(cfg: Optional[Dict[str, Any]] = None) -> None:
     worker_socks: List[socket.socket] = []
     barrier_waiting: List[socket.socket] = []
     state = {"stops": 0, "done": False, "failed": None}
+    # membership: id -> {"capacity", "progress", "last"(monotonic beat)}
+    members: Dict[str, Dict[str, Any]] = {}
+    mstate = {"epoch": 0, "closing": False, "ever": 0, "sweeping": False}
+
+    def _mview_locked() -> Dict[str, Any]:
+        return {"epoch": mstate["epoch"], "closing": mstate["closing"],
+                "members": {mid: {"capacity": m["capacity"],
+                                  "progress": m["progress"]}
+                            for mid, m in members.items()}}
+
+    def _mbump_locked(event: str, mid: str, reason: str = "") -> None:
+        from .. import telemetry
+        mstate["epoch"] += 1
+        telemetry.emit("membership", {
+            "event": event, "member": mid, "reason": reason,
+            "epoch": mstate["epoch"], "members": sorted(members)})
+
+    def _maybe_done_locked() -> None:
+        ps_used = bool(worker_socks) or state["stops"] > 0
+        ps_done = (not ps_used) or state["stops"] >= cfg["num_workers"]
+        m_used = mstate["ever"] > 0
+        m_done = (not m_used) or not members
+        if (ps_used or m_used) and ps_done and m_done:
+            state["done"] = True
+            lock.notify_all()
+
+    def _start_sweeper_locked() -> None:
+        """Heartbeat-expiry sweep: a member silent past the expiry window
+        is removed with an epoch bump — the partition/fencing path (a
+        kill is caught faster, by connection loss in ``handle``)."""
+        if mstate["sweeping"]:
+            return
+        mstate["sweeping"] = True
+        expiry = _elastic_expiry_ms() / 1000.0
+
+        def sweep():
+            while True:
+                time.sleep(max(0.05, expiry / 4.0))
+                with lock:
+                    if state["done"]:
+                        return
+                    now = time.monotonic()
+                    stale = [mid for mid, m in members.items()
+                             if now - m["last"] > expiry]
+                    for mid in stale:
+                        del members[mid]
+                        _mbump_locked("leave", mid, reason="expired")
+                    if stale:
+                        _maybe_done_locked()
+
+        threading.Thread(target=sweep, daemon=True).start()
 
     def _fail(reason: str):
         """Failure detection: a registered worker died before 'stop'.
@@ -216,6 +290,7 @@ def run_scheduler(cfg: Optional[Dict[str, Any]] = None) -> None:
     def handle(conn: socket.socket):
         is_worker = False
         stopped = False
+        joined: set = set()  # member ids joined on THIS connection
         try:
             while True:
                 msg = _recv(conn)
@@ -244,17 +319,84 @@ def run_scheduler(cfg: Optional[Dict[str, Any]] = None) -> None:
                             for c in barrier_waiting:
                                 _send(c, ("barrier_done",))
                             barrier_waiting.clear()
+                elif kind == "mjoin":
+                    mid, capacity = str(msg[1]), int(msg[2])
+                    with lock:
+                        members[mid] = {"capacity": capacity, "progress": 0,
+                                        "last": time.monotonic()}
+                        mstate["ever"] += 1
+                        joined.add(mid)
+                        _mbump_locked("join", mid)
+                        _start_sweeper_locked()
+                        view = _mview_locked()
+                    _send(conn, ("ok", view))
+                elif kind == "mbeat":
+                    mid = str(msg[1])
+                    progress = int(msg[2]) if len(msg) > 2 else None
+                    with lock:
+                        m = members.get(mid)
+                        if m is not None:
+                            m["last"] = time.monotonic()
+                            if progress is not None:
+                                m["progress"] = max(m["progress"], progress)
+                        # an expelled member still gets the view back:
+                        # seeing itself absent is how it learns it was
+                        # fenced out (docs/elastic.md)
+                        view = _mview_locked()
+                    _send(conn, ("ok", view))
+                elif kind == "mleave":
+                    mid = str(msg[1])
+                    final = bool(msg[2]) if len(msg) > 2 else False
+                    with lock:
+                        joined.discard(mid)
+                        changed = mid in members
+                        if changed:
+                            del members[mid]
+                        if final and not mstate["closing"]:
+                            mstate["closing"] = True
+                            changed = True
+                        if changed:
+                            _mbump_locked("leave", mid,
+                                          reason="final" if final
+                                          else "graceful")
+                        view = _mview_locked()
+                        _maybe_done_locked()
+                    _send(conn, ("ok", view))
+                elif kind == "mdead":
+                    # third-party death verdict (watchdog / operator)
+                    mid = str(msg[1])
+                    reason = str(msg[2]) if len(msg) > 2 else "reported"
+                    with lock:
+                        if mid in members:
+                            del members[mid]
+                            _mbump_locked("leave", mid, reason=reason)
+                        view = _mview_locked()
+                        _maybe_done_locked()
+                    _send(conn, ("ok", view))
+                elif kind == "mview":
+                    with lock:
+                        view = _mview_locked()
+                    _send(conn, ("ok", view))
                 elif kind == "stop":
                     stopped = True
                     with lock:
                         state["stops"] += 1
-                        if state["stops"] >= cfg["num_workers"]:
-                            state["done"] = True
-                            lock.notify_all()
+                        _maybe_done_locked()
                     return
         except (MXNetError, OSError):
             return
         finally:
+            if joined:
+                # a member's wire died before mleave: immediate expulsion
+                # (faster than the expiry sweep — a SIGKILLed process
+                # closes its TCP socket right away)
+                with lock:
+                    for mid in joined:
+                        if mid in members:
+                            del members[mid]
+                            _mbump_locked("leave", mid,
+                                          reason="connection-lost")
+                    _maybe_done_locked()
             if is_worker and not stopped:
                 _fail("a worker process died (connection lost before "
                       "'stop'); aborting the job")
@@ -432,16 +574,56 @@ def run_server(cfg: Optional[Dict[str, Any]] = None) -> None:
     lsock.close()
 
 
-def _connect(host: str, port: int, retries: int = 100) -> socket.socket:
-    for i in range(retries):
+def _connect_timeout_ms() -> int:
+    raw = os.environ.get("MXNET_TPU_DIST_CONNECT_TIMEOUT_MS", "").strip()
+    return int(raw) if raw else 15000
+
+
+def _send_retries() -> int:
+    raw = os.environ.get("MXNET_TPU_DIST_SEND_RETRIES", "").strip()
+    return int(raw) if raw else 3
+
+
+def _connect(host: str, port: int,
+             timeout_ms: Optional[int] = None) -> socket.socket:
+    """Dial with bounded exponential backoff + jitter.
+
+    The total dial budget is ``timeout_ms`` (default
+    ``MXNET_TPU_DIST_CONNECT_TIMEOUT_MS``, 15 s): sleeps start at 50 ms,
+    double per attempt up to a 1 s cap, and carry +/-50% jitter so a
+    whole cohort restarting at once does not hammer the scheduler in
+    lockstep.  Every re-dial increments the ``dist.connect_retries``
+    telemetry counter."""
+    from .. import telemetry
+    budget = (_connect_timeout_ms() if timeout_ms is None
+              else int(timeout_ms)) / 1000.0
+    deadline = time.monotonic() + budget
+    retries = telemetry.counter("dist.connect_retries")
+    attempt = 0
+    last: Optional[BaseException] = None
+    while True:
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         try:
-            s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
             s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            s.settimeout(min(2.0, max(0.1, budget)))
             s.connect((host, port))
+            s.settimeout(None)
             return s
-        except ConnectionRefusedError:
-            time.sleep(0.05 * min(i + 1, 10))
-    raise MXNetError(f"kvstore: cannot reach {host}:{port}")
+        except OSError as e:
+            try:
+                s.close()
+            except OSError:
+                pass
+            last = e
+        now = time.monotonic()
+        if now >= deadline:
+            raise MXNetError(
+                f"kvstore: cannot reach {host}:{port} within "
+                f"{budget:.1f}s ({last})")
+        retries.inc()
+        delay = min(1.0, 0.05 * (2 ** attempt)) * (0.5 + random.random())
+        time.sleep(min(delay, max(0.0, deadline - now)))
+        attempt += 1
 
 
 # ---------------------------------------------------------------------------
@@ -595,9 +777,38 @@ class DistKVStore(KVStore):
         return out
 
     def _rpc(self, sid: int, msg) -> Any:
+        """One request/reply on the server ``sid`` wire.
+
+        Transient socket failures (EPIPE/reset/close mid-exchange) are
+        retried up to ``MXNET_TPU_DIST_SEND_RETRIES`` times behind a
+        fresh ``_connect`` instead of raising on the first EPIPE; each
+        reconnect bumps ``dist.rpc_retries``.  A retried ``push`` whose
+        original request DID land before the reply was lost can
+        double-contribute to that key's round — acceptable, because the
+        only way the wire drops mid-exchange is a dying server process,
+        which loses the job's sync state anyway and aborts the round.
+        Server-*reported* errors (``("err", ...)`` replies) are designed
+        responses and never retried."""
+        from .. import telemetry
+        attempts = max(1, _send_retries() + 1)
         with self._sock_locks[sid]:
-            _send(self._server_socks[sid], msg)
-            reply = _recv(self._server_socks[sid])
+            for i in range(attempts):
+                try:
+                    _send(self._server_socks[sid], msg)
+                    reply = _recv(self._server_socks[sid])
+                    break
+                except (OSError, MXNetError) as e:
+                    transient = (isinstance(e, OSError)
+                                 or "connection closed" in str(e))
+                    if not transient or i + 1 >= attempts:
+                        raise
+                    telemetry.counter("dist.rpc_retries").inc()
+                    try:
+                        self._server_socks[sid].close()
+                    except OSError:
+                        pass
+                    host, port = self._server_addrs[sid]
+                    self._server_socks[sid] = _connect(host, port)
         if reply[0] != "ok":
             raise MXNetError(f"kvstore server error: {reply!r}")
         return reply
@@ -734,3 +945,196 @@ class DistKVStore(KVStore):
                 s.close()
             except OSError:
                 pass
+
+
+# ---------------------------------------------------------------------------
+# Membership client (elastic training rendezvous — docs/elastic.md)
+# ---------------------------------------------------------------------------
+
+class MembershipClient:
+    """One process's handle on the scheduler's membership view.
+
+    ``start()`` joins (``mjoin``) and spawns a beat thread that sends
+    ``mbeat`` every ``MXNET_TPU_ELASTIC_HEARTBEAT_MS`` (carrying this
+    member's ``progress``, e.g. the trainer's step counter) and installs
+    the view from every reply.  The view is an epoch-numbered dict
+    ``{"epoch", "closing", "members": {id: {"capacity", "progress"}}}``;
+    a changed epoch fires ``on_change(view)`` from the beat thread.
+
+    Detecting one's own expulsion: a member whose beats lapse past the
+    scheduler's expiry window (or that an ``mdead`` verdict named) is
+    removed from the view but keeps receiving view replies — once it
+    sees itself absent, :attr:`expelled` latches True and the process
+    must fence itself off (exit or rejoin under a new id) rather than
+    keep computing against a mesh that has moved on.
+
+    All wire traffic is request/reply on one socket behind a lock, so
+    user-thread RPCs (``leave``, ``report_dead``, ``beat_now``) never
+    interleave bytes with the beat thread.
+    """
+
+    def __init__(self, member_id: Optional[str] = None, capacity: int = 1,
+                 cfg: Optional[Dict[str, Any]] = None,
+                 heartbeat_ms: Optional[int] = None,
+                 on_change: Optional[Callable[[Dict[str, Any]], None]] = None,
+                 logger=None):
+        import logging
+        cfg = cfg or role_from_env()
+        if not cfg:
+            raise MXNetError(
+                "MembershipClient needs a launched cluster (MXTPU_ROLE / "
+                "MXTPU_PS_ROOT_URI / MXTPU_PS_ROOT_PORT env, see "
+                "mxnet_tpu.parallel.launch)")
+        self.member_id = str(member_id if member_id is not None
+                             else os.environ.get("MXTPU_WORKER_ID",
+                                                 str(os.getpid())))
+        self.capacity = int(capacity)
+        self.heartbeat_ms = (int(heartbeat_ms) if heartbeat_ms is not None
+                             else _elastic_heartbeat_ms())
+        self.on_change = on_change
+        self.logger = logger or logging.getLogger(__name__)
+        self._sock = _connect(cfg["root_host"], cfg["root_port"])
+        self._wire_lock = threading.Lock()
+        self._view_cond = threading.Condition()
+        self._view: Optional[Dict[str, Any]] = None
+        self._progress = 0
+        self._pause_until = 0.0
+        self._stop = threading.Event()
+        self._beat_thread: Optional[threading.Thread] = None
+        self._joined = False
+        self._left = False
+        self.expelled = False
+
+    # -- wire ----------------------------------------------------------
+
+    def _rpc(self, msg) -> Dict[str, Any]:
+        with self._wire_lock:
+            _send(self._sock, msg)
+            reply = _recv(self._sock)
+        if reply[0] != "ok":
+            raise MXNetError(f"membership rpc failed: {reply!r}")
+        view = reply[1]
+        self._install(view)
+        return view
+
+    def _install(self, view: Dict[str, Any]) -> None:
+        fire = None
+        with self._view_cond:
+            prev = self._view
+            if prev is not None and view["epoch"] < prev["epoch"]:
+                return  # stale reply raced a fresher one
+            bumped = prev is None or view["epoch"] > prev["epoch"]
+            self._view = view  # same-epoch replies refresh progress
+            if (self._joined and not self._left
+                    and self.member_id not in view["members"]):
+                self.expelled = True
+            self._view_cond.notify_all()
+            if bumped:
+                fire = self.on_change
+        if fire is not None:
+            try:
+                fire(view)
+            except Exception:
+                self.logger.exception("membership on_change callback failed")
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> "MembershipClient":
+        self._rpc(("mjoin", self.member_id, self.capacity))
+        self._joined = True
+        t = threading.Thread(target=self._beat_loop, daemon=True,
+                             name=f"membership-beat[{self.member_id}]")
+        t.start()
+        self._beat_thread = t
+        return self
+
+    def _beat_loop(self) -> None:
+        interval = self.heartbeat_ms / 1000.0
+        while not self._stop.is_set():
+            if time.monotonic() >= self._pause_until:
+                try:
+                    self.beat_now()
+                except (MXNetError, OSError):
+                    if not self._stop.is_set():
+                        self.logger.warning(
+                            "membership: beat failed (scheduler gone?)")
+                    return
+            self._stop.wait(interval)
+
+    def beat_now(self) -> Dict[str, Any]:
+        """One immediate beat (also refreshes the cached view)."""
+        return self._rpc(("mbeat", self.member_id, self._progress))
+
+    def set_progress(self, progress: int) -> None:
+        """Publish this member's step counter; travels with every beat
+        so peers (and chaos harnesses) can act on the trainer's clock."""
+        self._progress = max(self._progress, int(progress))
+
+    def pause_beats(self, seconds: float) -> None:
+        """Suppress beats for ``seconds`` — the chaos ``partition`` kind:
+        the scheduler's expiry sweep will fence this member out, and the
+        first post-pause beat shows it its own expulsion."""
+        self._pause_until = time.monotonic() + float(seconds)
+
+    # -- view ----------------------------------------------------------
+
+    @property
+    def view(self) -> Optional[Dict[str, Any]]:
+        with self._view_cond:
+            return self._view
+
+    @property
+    def epoch(self) -> int:
+        v = self.view
+        return -1 if v is None else int(v["epoch"])
+
+    def wait_for(self, predicate: Callable[[Dict[str, Any]], bool],
+                 timeout: float = 30.0) -> Optional[Dict[str, Any]]:
+        """Block until ``predicate(view)`` holds (returns that view) or
+        the timeout lapses (returns None).  The beat thread refreshes
+        the view, so the wait granularity is the heartbeat interval."""
+        deadline = time.monotonic() + timeout
+        with self._view_cond:
+            while True:
+                if self._view is not None and predicate(self._view):
+                    return self._view
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return None
+                self._view_cond.wait(left)
+
+    def wait_epoch_above(self, epoch: int,
+                         timeout: float = 30.0) -> Optional[Dict[str, Any]]:
+        return self.wait_for(lambda v: v["epoch"] > epoch, timeout)
+
+    # -- exits ---------------------------------------------------------
+
+    def leave(self, final: bool = False) -> None:
+        """Graceful exit (``final=True`` also flips the view's
+        ``closing`` flag, telling every other member to wind down)."""
+        if self._left:
+            return
+        self._left = True
+        try:
+            self._rpc(("mleave", self.member_id, final))
+        except (MXNetError, OSError):
+            pass
+
+    def report_dead(self, member_id: str, reason: str = "watchdog") -> None:
+        """Feed a third-party death verdict (the watchdog's, typically)
+        into the membership view — same epoch-bump event as a graceful
+        leave, so consumers need only one code path."""
+        try:
+            self._rpc(("mdead", str(member_id), reason))
+        except (MXNetError, OSError):
+            self.logger.warning("membership: could not report %s dead",
+                                member_id)
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._beat_thread is not None:
+            self._beat_thread.join(timeout=2.0)
+        try:
+            self._sock.close()
+        except OSError:
+            pass

@@ -45,13 +45,17 @@ def _env_for(role: str, num_workers: int, num_servers: int,
 def launch_local(cmd: Sequence[str], num_workers: int, num_servers: int = 1,
                  root_port: int = 9091,
                  worker_env: Optional[Dict[str, str]] = None,
-                 timeout: Optional[float] = None) -> int:
+                 timeout: Optional[float] = None,
+                 return_codes: bool = False):
     """Fork 1 scheduler + N servers + W workers of ``cmd`` on localhost.
 
     Server/scheduler processes run the SAME command: their
     ``kvstore.create('dist*')`` call becomes the blocking server loop
     (reference ``kvstore_server._init_kvstore_server_module``).  Returns
-    the max worker exit code.
+    the max worker exit code — or, with ``return_codes=True``, the full
+    per-worker exit-code list (worker index order), which elastic chaos
+    harnesses need: a deliberately killed worker's nonzero code must be
+    attributable instead of masking the survivors' verdict.
     """
     root_host = "127.0.0.1"
     procs: List[subprocess.Popen] = []
@@ -71,10 +75,10 @@ def launch_local(cmd: Sequence[str], num_workers: int, num_servers: int = 1,
         w = spawn("worker", dict(worker_env or {}, MXTPU_WORKER_ID=str(i)))
         workers.append(w)
         procs.append(w)
-    code = 0
+    codes = []
     try:
         for w in workers:
-            code = max(code, w.wait(timeout=timeout))
+            codes.append(w.wait(timeout=timeout))
     finally:
         for p in procs:
             if p.poll() is None:
@@ -82,7 +86,7 @@ def launch_local(cmd: Sequence[str], num_workers: int, num_servers: int = 1,
                     p.wait(timeout=10)
                 except subprocess.TimeoutExpired:
                     p.kill()
-    return code
+    return codes if return_codes else max([0] + codes)
 
 
 _SSH_OPTS = ("-o", "StrictHostKeyChecking=no", "-o", "BatchMode=yes")

@@ -74,13 +74,22 @@ class Watchdog:
     on_failure : callback ``(dead_rank) -> None``; default logs and
         hard-exits the process (the only reliable way out of a hung
         XLA collective).
+    on_death : optional observer ``(dead_rank) -> None`` called BEFORE
+        ``on_failure`` wherever a death verdict lands (the monitor's
+        declare and every peer's abort receipt) — the membership feed:
+        the elastic layer wires this to
+        ``MembershipClient.report_dead`` so a watchdog verdict and a
+        SIGTERM preemption notice raise the same "membership changed"
+        event (docs/elastic.md).  Exceptions are swallowed; the abort
+        path must never be blocked by an observer.
     """
 
     def __init__(self, rank: int, world: int,
                  monitor_addr: Tuple[str, int],
                  interval: float = 2.0,
                  timeout: Optional[float] = None,
-                 on_failure: Optional[Callable[[int], None]] = None):
+                 on_failure: Optional[Callable[[int], None]] = None,
+                 on_death: Optional[Callable[[int], None]] = None):
         self.rank = int(rank)
         self.world = int(world)
         self.monitor_addr = (monitor_addr[0], int(monitor_addr[1]))
@@ -88,6 +97,7 @@ class Watchdog:
         self.timeout = float(timeout if timeout is not None
                              else 5 * interval)
         self.on_failure = on_failure or _default_on_failure
+        self.on_death = on_death
         self._stop = threading.Event()
         self._threads = []
         self._server = None
@@ -100,6 +110,14 @@ class Watchdog:
             self._start_monitor()
         self._start_peer()
         return self
+
+    def _notify_death(self, dead_rank: int) -> None:
+        if self.on_death is None:
+            return
+        try:
+            self.on_death(dead_rank)
+        except Exception:
+            log.exception("watchdog: on_death observer failed (ignored)")
 
     def stop(self) -> None:
         self._stop.set()
@@ -225,6 +243,14 @@ class Watchdog:
         log.error("watchdog monitor: rank %d missed heartbeats — "
                   "broadcasting abort", peer)
         telemetry.counter("watchdog.deaths").inc(peer=str(peer))
+        # structured membership-leave event: operators and the elastic
+        # layer see WHICH peer died in the same "membership" stream the
+        # scheduler emits for joins/leaves/expiries (docs/elastic.md)
+        telemetry.emit("membership", {"event": "leave", "member": str(peer),
+                                      "reason": "watchdog-death",
+                                      "rank": self.rank,
+                                      "world": self.world})
+        self._notify_death(peer)
         # postmortem evidence BEFORE the abort broadcast: on_failure's
         # default hard-exits the process half a second from now
         telemetry.dump_flight("watchdog-peer-death",
@@ -297,6 +323,7 @@ class Watchdog:
                     (dead,) = struct.unpack("<i", data[len(_MAGIC) + 1:])
                     if not self._stop.is_set():
                         self._stop.set()
+                        self._notify_death(dead)
                         self.on_failure(dead)
                     return "done"
                 if kind == _ACK:

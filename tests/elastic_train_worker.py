@@ -1,0 +1,194 @@
+"""Worker program for the elastic-training chaos harness.
+
+Run by ``mxnet_tpu.parallel.launch.launch_local`` (scheduler + N
+membership workers, no PS servers).  Worker 0 is the trainer: it drives
+an :class:`ElasticTrainer` over the local 8-virtual-device CPU mesh,
+deriving the mesh size from the membership view (capacity sum,
+power-of-two floor).  The other workers are pure capacity members: they
+join, heartbeat, and mirror the trainer's published step clock so the
+chaos kinds fire on the *trainer's* schedule:
+
+* ``worker_kill:<step>`` — the targeted worker SIGKILLs itself once the
+  trainer's progress reaches ``<step>``; the scheduler sees the
+  connection drop, bumps the membership epoch, and the trainer resizes
+  (drain -> snapshot -> reshard -> zero-trace warm restart);
+* ``partition:<step>`` — the targeted worker stops heartbeating; the
+  expiry sweep fences it out, and on resuming beats it observes its own
+  expulsion and exits cleanly (the fencing contract).
+
+The trainer writes ``results.json`` (per-step head-output bytes, resize
+records, epochs, trace counts) into ``MXTPU_ELASTIC_OUT`` for the
+launching test/smoke to assert on: completion, membership-epoch bump,
+zero lost updates, pinned ``trace_counts``.
+
+Env knobs (cluster-env family, launcher-provided like MXTPU_ROLE):
+``MXTPU_ELASTIC_OUT`` (required for worker 0), ``MXTPU_ELASTIC_STEPS``
+(default 12), ``MXTPU_ELASTIC_CAPACITY`` (devices per member, default
+2).  Chaos comes from ``MXNET_TPU_CHAOS`` / ``MXNET_TPU_CHAOS_WORKER``.
+"""
+import json
+import os
+import signal
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import chaos  # noqa: E402
+from mxnet_tpu.parallel.dist_kvstore import (  # noqa: E402
+    MembershipClient, _elastic_expiry_ms, role_from_env, run_scheduler)
+
+STEPS = int(os.environ.get("MXTPU_ELASTIC_STEPS", "12"))
+CAPACITY = int(os.environ.get("MXTPU_ELASTIC_CAPACITY", "2"))
+# pace the trainer so the chaos worker's heartbeat-carried step clock
+# can land a mid-run fault (CPU steps finish in single-digit ms)
+STEP_SLEEP = float(os.environ.get("MXTPU_ELASTIC_STEP_SLEEP", "0.06"))
+
+
+def mlp():
+    d = mx.symbol.Variable("data")
+    f1 = mx.symbol.FullyConnected(data=d, name="fc1", num_hidden=16)
+    a = mx.symbol.Activation(data=f1, name="r", act_type="relu")
+    f2 = mx.symbol.FullyConnected(data=a, name="fc2", num_hidden=4)
+    return mx.symbol.SoftmaxOutput(data=f2, name="softmax")
+
+
+def batch(i):
+    rs = np.random.RandomState(100 + i)
+    return {"data": (rs.randn(32, 8) * 0.1).astype(np.float32),
+            "softmax_label": (rs.rand(32) * 4).astype(np.float32)}
+
+
+def trainer_progress(view):
+    """The trainer's published step clock (max over members: only the
+    trainer publishes nonzero progress)."""
+    return max([m["progress"] for m in view["members"].values()] or [0])
+
+
+def run_capacity_member(wid: str) -> int:
+    spec = chaos.elastic_from_env()
+    mine = spec is not None and chaos.chaos_worker() == int(wid)
+    kill_at = (min(spec.points["worker_kill"])
+               if mine and "worker_kill" in spec.points else None)
+    part_at = (min(spec.points["partition"])
+               if mine and "partition" in spec.points else None)
+    client = MembershipClient(member_id=wid, capacity=CAPACITY).start()
+    deadline = time.monotonic() + 300
+    while time.monotonic() < deadline:
+        if client.expelled:
+            # fenced out (partition kind): a member the view moved past
+            # must exit, not keep computing
+            print(f"worker {wid}: fenced out, exiting", flush=True)
+            client.close()
+            return 0
+        view = client.view
+        if view is not None:
+            prog = trainer_progress(view)
+            if kill_at is not None and prog >= kill_at:
+                print(f"worker {wid}: chaos worker_kill at trainer step "
+                      f"{prog}", flush=True)
+                os.kill(os.getpid(), signal.SIGKILL)
+            if part_at is not None and prog >= part_at:
+                print(f"worker {wid}: chaos partition at trainer step "
+                      f"{prog}", flush=True)
+                client.pause_beats(1.5 * _elastic_expiry_ms() / 1000.0)
+                part_at = None
+            if view["closing"]:
+                client.leave()
+                client.close()
+                return 0
+        time.sleep(0.02)
+    return 3  # timed out waiting for the run to wind down
+
+
+def run_trainer(wid: str) -> int:
+    from mxnet_tpu.checkpoint import CheckpointManager
+    from mxnet_tpu.parallel import ElasticTrainer
+
+    out_dir = os.environ["MXTPU_ELASTIC_OUT"]
+    expect = int(os.environ.get("MXTPU_NUM_WORKER", "1"))
+    client = MembershipClient(member_id=wid, capacity=CAPACITY).start()
+    if client.wait_for(lambda v: len(v["members"]) >= expect,
+                       timeout=60) is None:
+        print("trainer: peers never assembled", flush=True)
+        return 4
+    epoch0 = client.epoch
+
+    mgr = CheckpointManager(os.path.join(out_dir, "ckpt"))
+    mx.random.seed(7)
+    et = ElasticTrainer(mlp(), optimizer="sgd",
+                        optimizer_params={"learning_rate": 0.1},
+                        manager=mgr, membership=client,
+                        trainer_kwargs={"shard_optimizer": True})
+    # SIGTERM preemption and membership changes share one checkpoint
+    # path; a signal inside the resize's restoring() window skips the
+    # forced save (committed checkpoints stay source of truth)
+    mgr.install_preemption_hook(et.save_now, exit_after=True)
+    et.bind({"data": (32, 8)}, {"softmax_label": (32,)})
+
+    outputs, epochs, sizes = [], [], []
+    for i in range(STEPS):
+        out = et.step(batch(i))
+        outputs.append(np.asarray(jax.device_get(out[0])).tobytes().hex())
+        epochs.append(client.epoch)
+        sizes.append(et.size)
+        client.set_progress(i + 1)
+        client.beat_now()  # publish the step clock promptly
+        if STEP_SLEEP:
+            time.sleep(STEP_SLEEP)
+
+    results = {
+        "steps": STEPS,
+        "num_update": et.num_update,
+        "epoch_initial": epoch0,
+        "epoch_final": client.epoch,
+        "epochs": epochs,
+        "sizes": sizes,
+        "outputs": outputs,
+        "resizes": et.resizes,
+        "generation": et.generation,
+        "trace_counts": et.trace_counts,
+    }
+    tmp = os.path.join(out_dir, "results.json.tmp")
+    with open(tmp, "w") as f:
+        json.dump(results, f)
+    os.replace(tmp, os.path.join(out_dir, "results.json"))
+
+    et.shutdown(final=True)
+    mgr.uninstall_preemption_hook()
+    mgr.close()
+    client.close()
+    print(f"trainer: {STEPS} steps, {len(et.resizes)} resizes, "
+          f"epoch {epoch0}->{results['epoch_final']}", flush=True)
+    return 0
+
+
+def main() -> int:
+    cfg = role_from_env()
+    role = cfg.get("role")
+    if role == "scheduler":
+        run_scheduler(cfg)
+        return 0
+    if role == "server":
+        return 0  # the membership harness runs no PS servers
+    wid = os.environ.get("MXTPU_WORKER_ID", "0")
+    if wid == "0":
+        return run_trainer(wid)
+    return run_capacity_member(wid)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

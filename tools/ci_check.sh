@@ -83,6 +83,20 @@ if [[ "$mode" != "--tests-only" ]]; then
     fi
 fi
 
+if [[ "$mode" != "--tests-only" ]]; then
+    # end-to-end check of the elastic-training tier: a real launch_local
+    # membership cluster loses a SIGKILLed worker mid-run; the trainer
+    # must resize 8->4 with zero lost updates and zero retraces
+    # (docs/elastic.md)
+    echo "== elastic smoke (tools/elastic_smoke.py) =="
+    python tools/elastic_smoke.py
+    rc=$?
+    if [[ $rc -ne 0 ]]; then
+        echo "ci_check: elastic smoke FAILED (rc=$rc)" >&2
+        exit "$rc"
+    fi
+fi
+
 if [[ "$mode" == "--gate-only" ]]; then
     exit 0
 fi

@@ -1,0 +1,115 @@
+#!/usr/bin/env python
+"""CI smoke for elastic fault-tolerant training (docs/elastic.md).
+
+Launches a real 4-process membership cluster with ``launch_local``
+(scheduler + 4 workers, no PS servers; worker 0 is the
+:class:`ElasticTrainer`, the rest are capacity members) and SIGKILLs a
+live capacity worker once the trainer's published step clock reaches
+step 4 (``MXNET_TPU_CHAOS=worker_kill:4``).  Asserts, from the
+trainer's ``results.json``:
+
+1. the run COMPLETES: every scheduled update happened (zero lost
+   updates — the drain-then-snapshot resize is exact);
+2. the membership epoch bumped (the scheduler saw the death through
+   the dropped connection and renegotiated the view);
+3. the mesh shrank 8 -> 4 in exactly one resize with ``steps_lost ==
+   0`` and ``retraces == 0``;
+4. the post-resize generation's ``trace_counts`` are pinned at zero —
+   the AOT warm restart came entirely out of the compile cache;
+5. only the deliberately killed worker exited nonzero; the survivors
+   (and the fenced harness contract) all exited clean.
+
+Exit 0 on success, 1 with a reason on any failure.  Runs on the CPU
+mesh in ~10 s; invoked by tools/ci_check.sh after the serve smoke so
+the elastic seams (membership wire, resize pipeline, chaos kinds)
+cannot silently rot.
+"""
+from __future__ import annotations
+
+import json
+import os
+import socket
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault(
+    "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def fail(msg: str) -> None:
+    print(f"elastic_smoke: FAIL: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def main() -> None:
+    from mxnet_tpu.parallel.launch import launch_local
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+
+    out = tempfile.mkdtemp(prefix="elastic-smoke-")
+    # detection knobs tight enough for CI; the scheduler inherits them
+    # from this process (launch_local children copy os.environ)
+    os.environ["MXNET_TPU_ELASTIC_HEARTBEAT_MS"] = "100"
+    os.environ["MXNET_TPU_ELASTIC_EXPIRY_MS"] = "1000"
+
+    t0 = time.monotonic()
+    codes = launch_local(
+        [sys.executable, os.path.join(REPO, "tests",
+                                      "elastic_train_worker.py")],
+        num_workers=4, num_servers=0, root_port=port,
+        worker_env={"MXTPU_ELASTIC_OUT": out,
+                    "MXTPU_ELASTIC_STEPS": "12",
+                    "MXNET_TPU_CHAOS": "worker_kill:4",
+                    "MXNET_TPU_CHAOS_WORKER": "2"},
+        timeout=240, return_codes=True)
+    wall = time.monotonic() - t0
+
+    if len(codes) != 4:
+        fail(f"expected 4 worker exit codes, got {codes}")
+    if codes[2] == 0:
+        fail(f"chaos worker 2 was never killed (codes {codes})")
+    survivors = [codes[i] for i in (0, 1, 3)]
+    if survivors != [0, 0, 0]:
+        fail(f"survivors exited nonzero: {codes}")
+
+    results_path = os.path.join(out, "results.json")
+    if not os.path.exists(results_path):
+        fail("trainer never wrote results.json (run did not complete)")
+    with open(results_path) as f:
+        res = json.load(f)
+
+    if res["num_update"] != res["steps"]:
+        fail(f"lost updates: {res['num_update']}/{res['steps']}")
+    if res["epoch_final"] <= res["epoch_initial"]:
+        fail(f"membership epoch never bumped "
+             f"({res['epoch_initial']} -> {res['epoch_final']})")
+    if len(res["resizes"]) != 1:
+        fail(f"expected exactly 1 resize, got {res['resizes']}")
+    r = res["resizes"][0]
+    if (r["direction"], r["from_devices"], r["to_devices"]) != \
+            ("shrink", 8, 4):
+        fail(f"unexpected resize {r}")
+    if r["steps_lost"] != 0:
+        fail(f"resize lost {r['steps_lost']} steps (must be 0)")
+    if r["retraces"] != 0:
+        fail(f"resize retraced {r['retraces']} programs (must be 0)")
+    if any(v != 0 for v in res["trace_counts"].values()):
+        fail(f"post-resize generation traced: {res['trace_counts']}")
+
+    print(f"elastic_smoke: OK — worker killed at step 4, epoch "
+          f"{res['epoch_initial']}->{res['epoch_final']}, mesh 8->4 in "
+          f"{r['pause_ms']:.0f} ms pause, {res['num_update']}/"
+          f"{res['steps']} updates, 0 lost, 0 retraces "
+          f"({wall:.1f} s wall)")
+
+
+if __name__ == "__main__":
+    main()

@@ -35,6 +35,12 @@ MFU/bandwidth gauges), plus any tee'd audit rows and per-epoch
 resilience rows — the one-command answer to "what changed between
 these two runs" (docs/observability.md).
 
+``--diff-elastic A B`` diffs two ``bench.py --elastic`` reports
+(BENCH_r14.json): per-resize training-pause deltas, with absolute
+gates on B's correctness fields — steps lost, retraces, and the
+bitwise post-resize degradation check must all hold
+(docs/elastic.md).
+
 ``--diff-staticcheck A B`` diffs two ``staticcheck <cmd> --json``
 reports keyed by ``(rule, location)``: any unsuppressed non-info
 finding new in B is a regression (stderr + exit 1); findings present
@@ -256,11 +262,9 @@ def diff_audits(path_a, path_b):
     return 0
 
 
-def read_serve(path):
-    """{metric: row} for the serving rows of a ``bench.py --serve``
-    report (BENCH_r10.json-style JSON array, or one JSON object per
-    line).  Serve rows carry tokens/s plus per-token latency
-    percentiles (``p99_token_ms``) or the headline speedup ratio."""
+def _read_bench_rows(path, prefix):
+    """{metric: row} for the rows of a bench.py report (JSON array, or
+    one JSON object per line) whose metric starts with ``prefix``."""
     with open(path) as f:
         text = f.read()
     try:
@@ -279,7 +283,15 @@ def read_serve(path):
                 continue
     return {rec["metric"]: rec for rec in recs
             if isinstance(rec, dict)
-            and str(rec.get("metric", "")).startswith("serve ")}
+            and str(rec.get("metric", "")).startswith(prefix)}
+
+
+def read_serve(path):
+    """{metric: row} for the serving rows of a ``bench.py --serve``
+    report (BENCH_r10.json-style JSON array, or one JSON object per
+    line).  Serve rows carry tokens/s plus per-token latency
+    percentiles (``p99_token_ms``) or the headline speedup ratio."""
+    return _read_bench_rows(path, "serve ")
 
 
 # tokens/s gets a small noise floor (a shared CPU host wobbles a few
@@ -384,6 +396,62 @@ def diff_serve(path_a, path_b):
             if pct > SWAP_MS_GROWTH and sb - sa > SWAP_MS_SLACK:
                 worse.append(f"{metric}: swap latency grew "
                              f"{100 * pct:.0f}% ({sa:g} -> {sb:g} ms)")
+    for msg in worse:
+        print(f"REGRESSED: {msg}", file=sys.stderr)
+    return 1 if worse else 0
+
+
+# a resize pause is tiny (tens of ms) and jittery on shared CI; gate a
+# blow-up, not noise — both the relative AND absolute bars must trip
+ELASTIC_PAUSE_GROWTH = 0.50
+ELASTIC_PAUSE_SLACK_MS = 50.0
+
+
+def diff_elastic(path_a, path_b):
+    """Diff two ``bench.py --elastic`` reports (BENCH_r14.json), B
+    relative to A (docs/elastic.md).
+
+    Correctness rows are absolute gates on B alone: every resize must
+    lose 0 steps and run 0 retraces, and the round-trip summary row's
+    ``pass`` verdict (which folds in the bitwise degradation check)
+    must hold — an elastic resize that drops an update or compiles
+    cold has regressed no matter what A looked like.  The resize
+    *pause* is the one relative gate: growth beyond
+    ``ELASTIC_PAUSE_GROWTH`` AND ``ELASTIC_PAUSE_SLACK_MS`` fails."""
+    a = _read_bench_rows(path_a, "elastic ")
+    b = _read_bench_rows(path_b, "elastic ")
+    if not b:
+        print(f"no elastic rows in {path_b}", file=sys.stderr)
+        return 1
+    worse = []
+    print("| config | pause A | pause B | Δ% | lost B | retraces B |")
+    print("|---|---|---|---|---|---|")
+    for metric, rb in b.items():
+        ra = a.get(metric, {})
+        if rb.get("steps_lost", 0) != 0:
+            worse.append(f"{metric}: lost {rb['steps_lost']} steps "
+                         "(drain-then-snapshot must be exact)")
+        if rb.get("retraces", 0) != 0:
+            worse.append(f"{metric}: {rb['retraces']} retraces (warm "
+                         "restart must hit the compile cache)")
+        if rb.get("pass") is False:
+            worse.append(f"{metric}: pass=false "
+                         f"(target: {rb.get('target', '?')})")
+        if rb.get("bitwise_vs_fresh_mesh") is False:
+            worse.append(f"{metric}: post-resize segment diverged from "
+                         "a fresh run on the new mesh (must be bitwise)")
+        pa, pb = ra.get("pause_ms"), rb.get("pause_ms")
+        delta = ""
+        if pa and pb is not None:
+            pct = (pb - pa) / pa
+            delta = f"{100 * pct:+.1f}%"
+            if pct > ELASTIC_PAUSE_GROWTH \
+                    and pb - pa > ELASTIC_PAUSE_SLACK_MS:
+                worse.append(f"{metric}: resize pause grew "
+                             f"{100 * pct:.0f}% ({pa:g} -> {pb:g} ms)")
+        print(f"| {metric} | {pa if pa is not None else ''} "
+              f"| {pb if pb is not None else ''} | {delta} "
+              f"| {rb.get('steps_lost', '')} | {rb.get('retraces', '')} |")
     for msg in worse:
         print(f"REGRESSED: {msg}", file=sys.stderr)
     return 1 if worse else 0
@@ -549,6 +617,11 @@ def main():
                     "(BENCH_r10.json): exits 1 if tokens/s regressed "
                     "beyond the 5%% noise floor or p99 per-token "
                     "latency grew more than 10%%, B relative to A")
+    ap.add_argument("--diff-elastic", nargs=2, metavar=("A", "B"),
+                    help="diff two bench.py --elastic reports "
+                    "(BENCH_r14.json): exits 1 if any resize in B lost "
+                    "steps, retraced, failed the bitwise degradation "
+                    "check, or if the resize pause blew up vs A")
     ap.add_argument("--diff-staticcheck", nargs=2, metavar=("A", "B"),
                     help="diff two `staticcheck <cmd> --json` reports "
                     "keyed by (rule, location): exits 1 on any new "
@@ -559,6 +632,8 @@ def main():
         return diff_staticcheck(*args.diff_staticcheck)
     if args.diff_serve:
         return diff_serve(*args.diff_serve)
+    if args.diff_elastic:
+        return diff_elastic(*args.diff_elastic)
     if args.diff_profile:
         return diff_profiles(*args.diff_profile)
     if args.diff_resilience:
