@@ -913,6 +913,20 @@ def bench_serve(args):
     throughput fraction vs the clean run (the tokens/s dip);
     ``parse_log.py --diff-serve`` gates its correctness fields and
     swap-latency growth.
+
+    With ``--speculate`` (ISSUE 16) the draft-then-verify scenario
+    rides along and the report lands in ``BENCH_r15.json``: the
+    continuous config (stretched to 224-token streams at
+    max_seq_len=256, so the drafter's cold start amortizes) runs
+    non-speculative vs speculative (n-gram drafter, k=8) on an
+    **accept-friendly** greedy workload (the bench model's streams
+    collapse to short cycles — prompt-lookup heaven) and an
+    **adversarial** temperature workload (acceptance ~1/V by design).
+    The accept-friendly row gates >= 2x tokens/s at unchanged p99 mean
+    ITL (per-request mean inter-token gap — the burst-boundary gap is
+    its own informational column) with byte-identical greedy streams
+    and zero post-warmup traces; the adversarial row is informational
+    (acceptance-rate column, graceful degradation).
     """
     import jax
     from mxnet_tpu.models.transformer import transformer_lm
@@ -1176,8 +1190,120 @@ def bench_serve(args):
             "n_devices": len(jax.devices()),
         })
         _emit_row(rows[-1])
+    if getattr(args, "speculate", False):
+        spec_k = 8
+        # speculation's own workload: longer streams (224 new tokens at
+        # max_seq_len=256) so the drafter's cold start — the first few
+        # steps before the stream's cycle is visible in its own context
+        # — amortizes the way it does on real generation lengths.  The
+        # non-speculative baseline runs the SAME config and workload.
+        spec_tok = 224
+        spec_reqs = [(p, spec_tok) for p, _ in reqs]
+
+        def spec_drive(speculate, temp):
+            cfg = dict(heads=H, block_size=16, num_blocks=256,
+                       max_batch=8, max_queue=max(64, n_req),
+                       max_prompt_len=64, max_seq_len=256,
+                       prompt_bucket_min=16, prefill_chunk=16)
+            eng = Engine(params, EngineConfig(speculate=speculate,
+                                              spec_k=spec_k, **cfg))
+            eng.warmup()
+            warm = dict(eng.trace_counts)
+            t0 = time.perf_counter()
+            ids = [eng.submit(p, max_new_tokens=m, temperature=temp,
+                              top_k=(40 if temp else 0), seed=i)
+                   for i, (p, m) in enumerate(spec_reqs)]
+            eng.run()
+            wall = time.perf_counter() - t0
+            done = [eng.requests[i] for i in ids]
+            total = sum(len(q.tokens) for q in done)
+            # ITL, standard definition: per-request mean inter-token
+            # gap (generation wall / tokens-1), percentiled over
+            # requests.  A K-token burst lands K tokens in one step, so
+            # the raw gap between ARRIVALS is bimodal (~0 inside a
+            # burst, a full verify step at the boundary) — the boundary
+            # gap is reported separately as p99_burst_gap_ms.
+            mean_itl = [1e3 * (q.token_times[-1] - q.token_times[0])
+                        / max(len(q.token_times) - 1, 1) for q in done]
+            gaps = [1e3 * (b - a) for q in done
+                    for a, b in zip(q.token_times, q.token_times[1:])]
+            return {
+                "tokens_s": total / wall,
+                "tokens": total,
+                "wall_s": wall,
+                "p50_token_ms": float(np.percentile(mean_itl, 50)),
+                "p99_token_ms": float(np.percentile(mean_itl, 99)),
+                "p99_burst_gap_ms": float(np.percentile(gaps, 99)),
+                "streams": [q.tokens for q in done],
+                "new_traces": sum(dict(eng.trace_counts).values())
+                - sum(warm.values()),
+                "spec": eng.stats()["speculate"],
+            }
+
+        # accept-friendly: GREEDY traffic on the bench model collapses
+        # to short cycles, which the n-gram/prompt-lookup drafter nails
+        # — the workload the 2x bar is set on.  adversarial:
+        # temperature traffic scatters the stream, acceptance goes to
+        # ~1/V — the row pins that the engine degrades gracefully
+        # (live rows still emit >= 1 token/step) instead of gating a
+        # speedup speculation cannot deliver there.
+        for label, temp, gated in (("accept-friendly greedy", 0.0, True),
+                                   ("adversarial temp=0.9", 0.9, False)):
+            base = spec_drive(False, temp)
+            spec = spec_drive(True, temp)
+            speedup = spec["tokens_s"] / base["tokens_s"]
+            # "unchanged p99 ITL": within 10% + 2 ms scheduling slack
+            itl_ok = (spec["p99_token_ms"]
+                      <= base["p99_token_ms"] * 1.10 + 2.0)
+            ident = bool(spec["streams"] == base["streams"])
+            zero = (spec["new_traces"] == 0 and base["new_traces"] == 0)
+            ar = spec["spec"]["accept_rate"]
+            row = {
+                "metric": f"serve speculative decode {label} (k={spec_k}"
+                          f" ngram, {n_req} reqs x {spec_tok} new tokens,"
+                          f" {dev})",
+                "value": round(speedup, 2),
+                "unit": "x tokens/s vs non-speculative same-run",
+                "vs_baseline": None,
+                "tokens_s": round(spec["tokens_s"], 1),
+                "base_tokens_s": round(base["tokens_s"], 1),
+                "accept_rate": round(ar, 3),
+                "tokens_per_step": round(
+                    spec["spec"]["tokens_per_step"], 2),
+                "drafted": spec["spec"]["drafted"],
+                "accepted": spec["spec"]["accepted"],
+                "p99_token_ms": round(spec["p99_token_ms"], 2),
+                "base_p99_token_ms": round(base["p99_token_ms"], 2),
+                "p50_token_ms": round(spec["p50_token_ms"], 2),
+                "p99_burst_gap_ms": round(spec["p99_burst_gap_ms"], 2),
+                "streams_identical": ident,
+                "new_traces": spec["new_traces"],
+                "temperature": temp,
+                "spec_k": spec_k,
+                "draft": "ngram",
+                "wall_s": round(spec["wall_s"], 2),
+                "n_devices": len(jax.devices()),
+            }
+            if gated:
+                row["target"] = (">= 2x non-speculative tokens/s, p99 "
+                                 "mean ITL <= 1.10x + 2 ms, greedy "
+                                 "streams byte-identical, zero "
+                                 "post-warmup traces")
+                row["pass"] = bool(speedup >= 2.0 and itl_ok and ident
+                                   and zero)
+            else:
+                row["target"] = ("informational: acceptance collapses "
+                                 "by design; >= 1 token/row/step, zero "
+                                 "post-warmup traces")
+                row["pass"] = bool(
+                    spec["spec"]["tokens_per_step"] >= 1.0 and zero)
+            rows.append(row)
+            _emit_row(row)
     out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                       "BENCH_r13.json" if getattr(args, "hotswap", False)
+                       "BENCH_r15.json" if getattr(args, "speculate",
+                                                   False)
+                       else "BENCH_r13.json"
+                       if getattr(args, "hotswap", False)
                        else "BENCH_r12.json"
                        if getattr(args, "chaos", False)
                        else "BENCH_r11.json")
@@ -1572,6 +1698,11 @@ def main():
                     "(Router.rolling_swap of a null update mid-run; "
                     "per-replica swap latency, tokens/s dip, streams "
                     "byte-identical, zero retraces) -> BENCH_r13.json")
+    ap.add_argument("--speculate", action="store_true",
+                    help="--serve: add the speculative-decoding "
+                    "scenario (n-gram draft + K-token verify; "
+                    "accept-friendly and adversarial rows, acceptance "
+                    "rate, greedy byte-identity) -> BENCH_r15.json")
     ap.add_argument("--elastic", action="store_true",
                     help="elastic-training scenario (docs/elastic.md): "
                     "in-process 8->4->8 live mesh resize (drain + "

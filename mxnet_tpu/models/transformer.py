@@ -274,6 +274,43 @@ def transformer_lm_prefill_chunk(params, tokens, *, heads, attend):
     return _lm_head(params, h)
 
 
+def transformer_lm_verify(params, tokens, *, heads, attend):
+    """Speculative-decode **verify** window: score C candidate
+    positions per request in one forward over a caller-owned KV cache.
+
+    The K-position extension of :func:`transformer_lm_decode`:
+    ``tokens`` is [B, C] — per request, position 0 is the current last
+    token and 1..C-1 a drafted continuation — and
+    ``attend(layer, q, k, v)`` receives the window's per-head states
+    ([B, C, H, hd] each), must extend the caller's cache with
+    ``k``/``v`` and return each window position's causal attention over
+    the full cached prefix (the window's earlier positions included) as
+    [B, C, H, hd].  Returns logits [B, C, V]: row ``c`` scores the
+    token *following* drafted position ``c`` — exactly what acceptance
+    needs.  A window of C=1 is the decode twin; no positional
+    embedding exists in this architecture, so absolute offsets are the
+    attend closure's business (the serve tier passes them to
+    ``serve.kvcache.paged_verify_attention``).
+    """
+    vocab, num_layers, d = lm_config_from_params(params)
+    if d % heads:
+        raise MXNetError(f"d_model {d} not divisible by heads {heads}")
+    hd = d // heads
+    b, c = tokens.shape
+    h = jnp.take(_param(params, "embed_weight"),
+                 tokens.astype(jnp.int32), axis=0)
+
+    def make_attend(i):
+        def _attend(q, k, v):
+            q, k, v = (t.reshape(b, c, heads, hd) for t in (q, k, v))
+            return attend(i, q, k, v).reshape(b, c, d)
+        return _attend
+
+    for i in range(num_layers):
+        h = _block_step(params, i, h, make_attend(i))
+    return _lm_head(params, h)
+
+
 def transformer_lm_decode(params, tokens, *, heads, attend):
     """One incremental decode step over a caller-owned KV cache.
 

@@ -21,13 +21,19 @@ in, concurrent token streams come out.
   zero-downtime rolling weight deploys (``rolling_swap`` +
   ``Engine.swap_weights`` — the serve half of the round-13
   train→serve loop, :mod:`mxnet_tpu.online` / docs/train_serve.md).
+* :mod:`~mxnet_tpu.serve.speculate` — draft sources for speculative
+  decoding: n-gram/prompt-lookup and small-model drafters feeding the
+  engine's replay-exact K-token verify step
+  (``MXNET_TPU_SERVE_SPECULATE=1``, docs/serving.md).
 """
-from . import engine, kvcache, router, scheduler
+from . import engine, kvcache, router, scheduler, speculate
 from .engine import Engine, EngineConfig
 from .kvcache import BlockAllocator
 from .router import Router, RouterConfig
 from .scheduler import Request, Scheduler, ServeError
+from .speculate import Drafter, ModelDrafter, NGramDrafter, make_drafter
 
 __all__ = ["Engine", "EngineConfig", "BlockAllocator", "Request",
            "Router", "RouterConfig", "Scheduler", "ServeError",
-           "engine", "kvcache", "router", "scheduler"]
+           "Drafter", "ModelDrafter", "NGramDrafter", "make_drafter",
+           "engine", "kvcache", "router", "scheduler", "speculate"]

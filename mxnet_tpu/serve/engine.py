@@ -52,8 +52,10 @@ from .. import telemetry
 from ..base import MXNetError
 from ..models.transformer import (lm_config_from_params,
                                   transformer_lm_decode,
-                                  transformer_lm_prefill)
+                                  transformer_lm_prefill,
+                                  transformer_lm_verify)
 from . import kvcache
+from . import speculate as speculate_mod
 from .scheduler import (CANCELLED, FAILED, FINISHED, Request, Scheduler,
                         ServeError)
 
@@ -103,10 +105,15 @@ class EngineConfig:
     kv_quant: Optional[str] = None   # None (f32) | "fp8" (e4m3+scales)
     attn_impl: str = "auto"       # auto | scan | dense | flash
                                   # | flash_interpret
+    # -- round-15 speculative decoding (docs/serving.md) --
+    speculate: bool = False       # draft-then-verify multi-token steps
+    spec_k: int = 4               # drafted tokens per verify window
+    spec_draft: str = "ngram"     # "ngram" (prompt lookup) | "model"
+    spec_window: int = 16         # model drafter's context window
 
     @classmethod
     def from_env(cls, **overrides) -> "EngineConfig":
-        """Environment defaults (docs/env_vars.md rounds 11-12);
+        """Environment defaults (docs/env_vars.md rounds 11-12, 17);
         explicit kwargs win."""
         env = dict(
             block_size=_env_int("MXNET_TPU_SERVE_BLOCK_SIZE", 16),
@@ -121,6 +128,10 @@ class EngineConfig:
                       .strip().lower() or None),
             attn_impl=(os.environ.get("MXNET_TPU_SERVE_ATTN", "")
                        .strip().lower() or "auto"),
+            speculate=bool(_env_int("MXNET_TPU_SERVE_SPECULATE", 0)),
+            spec_k=_env_int("MXNET_TPU_SERVE_SPEC_K", 4),
+            spec_draft=(os.environ.get("MXNET_TPU_SERVE_SPEC_DRAFT", "")
+                        .strip().lower() or "ngram"),
         )
         env.update(overrides)
         return cls(**env)
@@ -188,13 +199,136 @@ def _sample_row(logits, key, temp, topk, pos):
 
 _sample_batch = jax.vmap(_sample_row, in_axes=(0, 0, 0, 0, 0))
 
+# PRNG salts: acceptance-u and residual draws fold one extra constant
+# into the per-position key chain (``fold_in(key, pos)``), so they are
+# independent streams from the plain token draw at the same position —
+# and the plain draw itself stays untouched, which is what makes a
+# live=0 speculative row byte-identical to non-speculative decode.
+_SALT_ACCEPT = 0x5ACC
+_SALT_RESID = 0x5E51
+
+
+def _spec_accept_row(logits, toks, live, key, temp, topk, length):
+    """Replay-exact acceptance for one request's verify window.
+
+    ``logits``: [C, V] target scores (row c scores the token after
+    window position c); ``toks``: [C] — ``toks[0]`` the current last
+    token, ``toks[1:]`` the K drafted tokens; ``live``: how many drafts
+    are in play for this row (0..K — budget/shape clamps); ``length``:
+    cache entries before this step, so the token sampled from
+    ``logits[c]`` sits at absolute position ``length + 1 + c`` (the
+    same position-keying as plain decode).
+
+    Greedy (temp == 0): draft c is accepted iff it equals
+    ``argmax(logits[c-1])`` — the emitted stream is the non-speculative
+    argmax stream token for token.  Temperature: draft x at position p
+    is accepted iff ``u < p(x)`` with ``p`` the temp/top-k sampling
+    distribution and ``u`` uniform from the salted position key; a
+    rejected draft resamples the residual — ``p`` with x's point mass
+    removed and renormalized (its logit masked to -inf) — which makes
+    the emitted marginal exactly ``p`` for ANY deterministic drafter:
+    ``p(x)·δx + (1-p(x))·(p-p(x)δx)/(1-p(x)) = p``.  When every live
+    draft is accepted the bonus token is drawn by the plain sampler
+    (:func:`_sample_row`) at its position, so a live=0 row degrades to
+    plain decode bit-for-bit, temperature included.
+
+    Returns ``(out [C] int32, n_emit int32)``: ``out[:n_emit]`` are the
+    emitted tokens (accepted drafts + the correction/bonus token).
+    """
+    logits = logits.astype(jnp.float32)
+    c, vocab = logits.shape
+    k = c - 1
+    draft = toks[1:]
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = logits / jnp.maximum(temp, 1e-6)
+    kth = jnp.take_along_axis(
+        jnp.flip(jnp.sort(scaled, axis=-1), -1),
+        jnp.full((c, 1), jnp.clip(topk - 1, 0, vocab - 1)), axis=-1)
+    masked = jnp.where((topk > 0) & (scaled < kth), _NEG, scaled)
+    probs = jax.nn.softmax(masked, axis=-1)
+    pos = length + 1 + jnp.arange(c)
+
+    def accept_u(p):
+        return jax.random.uniform(jax.random.fold_in(
+            jax.random.fold_in(key, p), _SALT_ACCEPT))
+
+    us = jax.vmap(accept_u)(pos[:k])
+    p_draft = jnp.take_along_axis(probs[:k], draft[:, None], axis=1)[:, 0]
+    acc = jnp.where(temp > 0, us < p_draft, greedy[:k] == draft)
+    acc = acc & (jnp.arange(k) < live)
+    a = jnp.sum(jnp.cumprod(acc.astype(jnp.int32)))   # leading accepts
+    la = jnp.take(logits, a, axis=0)
+    # all live drafts accepted -> bonus token, the PLAIN sampler at its
+    # position (exactly the non-speculative draw)
+    bonus = _sample_row(la, key, temp, topk, length + 1 + a)
+    # rejection -> greedy corrects with argmax; temperature draws the
+    # residual (draft's point mass masked out) from a salted stream
+    d_rej = jnp.take(draft, jnp.minimum(a, k - 1))
+    resid_logits = jnp.take(masked, a, axis=0).at[d_rej].set(_NEG)
+    rkey = jax.random.fold_in(jax.random.fold_in(key, length + 1 + a),
+                              _SALT_RESID)
+    resid = jax.random.categorical(rkey, resid_logits).astype(jnp.int32)
+    corr = jnp.where(temp > 0, resid, jnp.take(greedy, a))
+    final = jnp.where(a >= live, bonus, corr)
+    idx = jnp.arange(c)
+    draft_pad = jnp.concatenate([draft, jnp.zeros((1,), draft.dtype)])
+    out = jnp.where(idx == a, final, jnp.where(idx < a, draft_pad, 0))
+    return out.astype(jnp.int32), (a + 1).astype(jnp.int32)
+
+
+_spec_accept_batch = jax.vmap(_spec_accept_row,
+                              in_axes=(0, 0, 0, 0, 0, 0, 0))
+
+
+def _spec_accept_row_greedy(logits, toks, live):
+    """Greedy-only acceptance: for temp == 0 the full rule collapses
+    to pure argmax (accept iff draft == argmax; both the correction
+    and the bonus token ARE ``argmax(logits[a])``), so an all-greedy
+    batch needs no sort, no softmax, no PRNG.  Produces exactly the
+    integers :func:`_spec_accept_row` produces at temp == 0 — the
+    verify program picks this branch under ``lax.cond``, so greedy
+    byte-identity is preserved by construction."""
+    logits = logits.astype(jnp.float32)
+    c = logits.shape[0]
+    k = c - 1
+    draft = toks[1:]
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    acc = (greedy[:k] == draft) & (jnp.arange(k) < live)
+    a = jnp.sum(jnp.cumprod(acc.astype(jnp.int32)))
+    idx = jnp.arange(c)
+    draft_pad = jnp.concatenate([draft, jnp.zeros((1,), draft.dtype)])
+    out = jnp.where(idx == a, jnp.take(greedy, a),
+                    jnp.where(idx < a, draft_pad, 0))
+    return out.astype(jnp.int32), (a + 1).astype(jnp.int32)
+
+
+_spec_accept_batch_greedy = jax.vmap(_spec_accept_row_greedy,
+                                     in_axes=(0, 0, 0))
+
+
+def _spec_accept(logits, tokens, live, keys, temps, topks, lengths):
+    """Batch acceptance with an all-greedy fast path.  ``lax.cond``
+    executes only the taken branch, so a greedy batch (the common
+    serving case, and the accept-friendly bench row) skips the top-k
+    sort, softmax, and threefry chains entirely; any temperature row
+    in the batch routes the whole batch through the full rule.  Both
+    branches emit identical integers for temp == 0 rows, so the
+    branch choice can never change a stream."""
+    return jax.lax.cond(
+        jnp.any(temps > 0.0),
+        lambda: _spec_accept_batch(logits, tokens, live, keys, temps,
+                                   topks, lengths),
+        lambda: _spec_accept_batch_greedy(logits, tokens, live))
+
 
 class Engine:
     """Continuous-batching autoregressive server for ``transformer_lm``
     parameter dicts.  See the module docstring for the step anatomy."""
 
     def __init__(self, params: Dict[str, Any], config: EngineConfig,
-                 chaos: Optional[chaos_mod.ChaosSpec] = None):
+                 chaos: Optional[chaos_mod.ChaosSpec] = None,
+                 draft_params: Optional[Dict[str, Any]] = None,
+                 draft_heads: Optional[int] = None):
         self.config = config
         # chaos=None reads MXNET_TPU_CHAOS (serve_* kinds); pass an
         # empty ChaosSpec to force chaos off (the router does, for
@@ -259,14 +393,40 @@ class Engine:
         self.step_idx = 0
         self.swap_count = 0      # successful swap_weights installs
         self._chunk_ms = 0.0   # EWMA chunk-prefill latency (SLO backlog)
+        # -- round-15 speculative decoding --
+        self.spec: Optional[speculate_mod.Drafter] = None
+        self.spec_k = int(config.spec_k)
+        self._spec_drafted = 0   # lifetime drafted positions
+        self._spec_accepted = 0  # lifetime accepted drafts
+        self._decode_ms = 0.0    # EWMA decode/verify step latency
+        self._tps = 1.0          # EWMA tokens emitted per row per step
+        if config.speculate:
+            if self.spec_k < 1:
+                raise MXNetError(f"spec_k must be >= 1, got {self.spec_k}")
+            if self.spec_k + 1 >= config.max_seq_len:
+                raise MXNetError(
+                    f"spec_k {self.spec_k} cannot exceed max_seq_len "
+                    f"{config.max_seq_len} - 2")
+            self.spec = speculate_mod.make_drafter(
+                config.spec_draft, draft_params=draft_params,
+                draft_heads=(draft_heads if draft_heads is not None
+                             else self.heads),
+                window=config.spec_window)
+            if self.spec.kind == "model":
+                self.spec.bind_runner(self._run_draft_program)
         # "serve2": program outputs grew a finite-logits guard flag —
-        # old cached executables have the wrong output arity
+        # old cached executables have the wrong output arity.  The spec
+        # suffix appears ONLY when speculation is on, so every
+        # non-speculative program key (and warm disk cache) is
+        # untouched by this round.
+        spec_tag = (f":spec{self.spec_k}:{self.spec.signature()}"
+                    if self.spec is not None else "")
         self._fingerprint = (
             f"serve2:{self.vocab}:{self.num_layers}:{self.d_model}:"
             f"{self.heads}:bs{bs}:nb{config.num_blocks}:"
             f"mb{self.max_blocks}:{np.dtype(config.dtype).name}:"
             f"pc{self.prefill_chunk}:kv{config.kv_quant or 'f32'}:"
-            f"{self.attn_impl}")
+            f"{self.attn_impl}{spec_tag}")
         telemetry.gauge("kv_bytes_per_token").set(
             kvcache.kv_bytes_per_token(self.num_layers, self.heads,
                                        self.head_dim, config.kv_quant,
@@ -331,6 +491,26 @@ class Engine:
         self.swap_count += 1
         telemetry.counter("online.swaps").inc()
         return report.to_dict()
+
+    def swap_draft_weights(self, params_or_source: Any,
+                           epoch: Optional[int] = None) -> Dict[str, Any]:
+        """Hot-swap the DRAFT model's weights, independently of the
+        target (docs/serving.md §Speculative decoding).  Draft weights
+        are operands of the draft program — a signature-compatible swap
+        runs zero retraces, and the output contract is untouched: only
+        acceptance rates move, never the emitted stream (greedy) or its
+        distribution (temperature).  Requires a 'model' drafter."""
+        if self.spec is None or self.spec.kind != "model":
+            raise MXNetError(
+                "swap_draft_weights: engine has no model drafter "
+                "(speculate off, or spec_draft='ngram')")
+        if isinstance(params_or_source, str):
+            from ..predictor import load_weights
+            _, params_or_source, _, _ = load_weights(params_or_source,
+                                                     epoch)
+        report = self.spec.swap(params_or_source)
+        telemetry.counter("serve.spec.draft_swaps").inc()
+        return report
 
     # -- program construction ---------------------------------------------
 
@@ -421,6 +601,94 @@ class Engine:
 
         return fn
 
+    def _make_verify_fn(self, bb: int):
+        """The speculative step program: write the window's K/V, score
+        all K+1 positions causally against the paged cache
+        (:func:`transformer_lm_verify`), run replay-exact acceptance,
+        and scrub the rejected tail — one fixed-shape program per
+        decode bucket, replacing the decode program entirely when
+        speculation is on (a row with ``live=0`` IS a decode step)."""
+        heads, nl = self.heads, self.num_layers
+        c = self.spec_k + 1
+        bsz = self.config.block_size
+        mb = self.max_blocks
+
+        def fn(kpool, vpool, params, tokens, tables, lengths, live,
+               active, keys, temps, topks):
+            self.trace_counts[f"verify@{bb}"] += 1
+            pools = [kpool, vpool]
+            win = jnp.arange(c)[None, :]
+            posm = lengths[:, None] + win                  # [bb, C] writes
+            logical = jnp.minimum(posm // bsz, mb - 1)
+            slot_raw = jnp.take_along_axis(tables, logical, axis=1)
+            writemask = active[:, None] & (win <= live[:, None])
+            slots = jnp.where(writemask, slot_raw, kvcache.TRASH_BLOCK)
+            offs = posm % bsz
+
+            def attend(i, q, k, v):
+                pools[0] = kvcache.write_spec(pools[0], i, k, slots, offs)
+                pools[1] = kvcache.write_spec(pools[1], i, v, slots, offs)
+                return kvcache.paged_verify_attention(
+                    q, kvcache.layer_view(pools[0], i),
+                    kvcache.layer_view(pools[1], i), tables, lengths)
+
+            logits = transformer_lm_verify(params, tokens, heads=heads,
+                                           attend=attend)
+            out, nem = _spec_accept(logits, tokens, live, keys,
+                                    temps, topks, lengths)
+            # cursor rollback: the block cursor truncates to the last
+            # accepted draft, and the rejected tail's K/V is scrubbed
+            # in-graph (kept positions redirect to the trash block)
+            scrub = writemask & (win > (nem - 1)[:, None])
+            sslots = jnp.where(scrub, slot_raw, kvcache.TRASH_BLOCK)
+            pools[0] = kvcache.scrub_positions(pools[0], sslots, offs)
+            pools[1] = kvcache.scrub_positions(pools[1], sslots, offs)
+            # finite guard over the window positions acceptance read
+            # (dead positions attend over unwritten garbage by design)
+            livemask = win <= live[:, None]
+            oks = jnp.all(jnp.isfinite(logits.astype(jnp.float32))
+                          | ~livemask[:, :, None], axis=(1, 2))
+            return pools[0], pools[1], out, nem, oks
+
+        return fn
+
+    def _make_draft_fn(self, bb: int):
+        """The model drafter's program: K-step greedy unroll of the
+        small LM over a right-aligned context window.  Draft weights
+        are operands (hot-swappable); drafting is deterministic in the
+        window, which the temperature path's replay-exactness needs."""
+        k = self.spec_k
+        heads, w = self.spec.heads, self.spec.window
+
+        def fn(dparams, window, ctx_len):
+            self.trace_counts[f"draft@{bb}"] += 1
+            toks, ln = window, ctx_len
+            outs = []
+            for _ in range(k):
+                logits = speculate_mod.draft_window_logits(
+                    dparams, toks, ln, heads=heads)
+                nxt = jnp.argmax(logits.astype(jnp.float32),
+                                 axis=-1).astype(jnp.int32)
+                outs.append(nxt)
+                toks = jnp.concatenate([toks[:, 1:], nxt[:, None]], axis=1)
+                ln = jnp.minimum(ln + 1, w)
+            return jnp.stack(outs, axis=1)
+
+        return fn
+
+    def _run_draft_program(self, win: np.ndarray, lens: np.ndarray):
+        """Runner bound into the ModelDrafter: pad to the decode
+        bucket, run the AOT draft program, strip the padding."""
+        n = win.shape[0]
+        bb = cc.bucket_for(n, self.decode_buckets)
+        self._ensure_program("draft", bb)
+        padw = np.zeros((bb, self.spec.window), np.int32)
+        padw[:n] = win
+        padl = np.ones((bb,), np.int32)
+        padl[:n] = np.maximum(lens, 1)
+        out = self._programs[("draft", bb)](self.spec.params, padw, padl)
+        return np.asarray(out)[:n]
+
     def _pool_aval(self):
         sds = jax.ShapeDtypeStruct
         return jax.tree_util.tree_map(lambda a: sds(a.shape, a.dtype),
@@ -442,6 +710,15 @@ class Engine:
                     key, sds((), jnp.float32), sds((), jnp.int32))
         b = bucket
         i32 = lambda *s: sds(s, jnp.int32)
+        if kind == "draft":
+            dparams = {k: sds(v.shape, v.dtype)
+                       for k, v in self.spec.params.items()}
+            return (dparams, i32(b, self.spec.window), i32(b))
+        if kind == "verify":
+            return (pool, pool, params, i32(b, self.spec_k + 1),
+                    i32(b, self.max_blocks), i32(b), i32(b),
+                    sds((b,), jnp.bool_), sds((b, 2), jnp.uint32),
+                    sds((b,), jnp.float32), i32(b))
         return (pool, pool, params, i32(b), i32(b, self.max_blocks),
                 i32(b), i32(b), i32(b), sds((b,), jnp.bool_),
                 sds((b, 2), jnp.uint32), sds((b,), jnp.float32), i32(b))
@@ -452,10 +729,14 @@ class Engine:
             return {"source": "ready", "kind": kind, "bucket": bucket}
         make = {"prefill": self._make_prefill_fn,
                 "prefill_chunk": self._make_chunk_prefill_fn,
-                "decode": self._make_decode_fn}[kind]
-        jit_fn = jax.jit(make(bucket), donate_argnums=(0, 1))
+                "decode": self._make_decode_fn,
+                "verify": self._make_verify_fn,
+                "draft": self._make_draft_fn}[kind]
+        # the draft program owns no pools — nothing to donate
+        donate = () if kind == "draft" else (0, 1)
+        jit_fn = jax.jit(make(bucket), donate_argnums=donate)
         avals = self._avals(kind, bucket)
-        ckey = cc.program_key(self._fingerprint, avals, donate=(0, 1),
+        ckey = cc.program_key(self._fingerprint, avals, donate=donate,
                               extra={"serve": kind, "bucket": bucket})
         compiled, info = cc.get_cache().get_or_compile(
             ckey, lambda: jit_fn.lower(*avals).compile(),
@@ -467,13 +748,20 @@ class Engine:
     def warmup(self) -> List[Dict[str, Any]]:
         """Resolve every prefill/decode bucket program through the
         compile cache.  After this, steady-state serving runs zero
-        traces (``trace_counts`` stays flat — pinned by tests)."""
+        traces (``trace_counts`` stays flat — pinned by tests).  With
+        speculation on, the verify program replaces the decode program
+        (one more AOT bucket family, not one more per step) and a
+        'model' drafter warms its draft program too."""
         with telemetry.span("serve.warmup"):
             pkind = "prefill_chunk" if self.prefill_chunk else "prefill"
             infos = [self._ensure_program(pkind, lb)
                      for lb in self.prompt_buckets]
-            infos += [self._ensure_program("decode", bb)
+            dkind = "verify" if self.spec is not None else "decode"
+            infos += [self._ensure_program(dkind, bb)
                       for bb in self.decode_buckets]
+            if self.spec is not None and self.spec.kind == "model":
+                infos += [self._ensure_program("draft", bb)
+                          for bb in self.decode_buckets]
         return infos
 
     # -- submit / stream / cancel -----------------------------------------
@@ -653,7 +941,8 @@ class Engine:
                             queued=self.sched.queue_depth):
             admitted = self.sched.admit(
                 self._admission_gate(), now,
-                prefill_backlog_ms=self._prefill_backlog_ms())
+                prefill_backlog_ms=self._prefill_backlog_ms(),
+                decode_backlog_ms=self._decode_backlog_ms())
         if self.prefill_chunk:
             for req in admitted:
                 self._prefill_begin(req)
@@ -850,13 +1139,15 @@ class Engine:
             if r.prefilled < r.prefill_target)
         return remaining * self._chunk_ms
 
-    def _grow_blocks(self, req: Request) -> bool:
-        """Ensure the request owns a block for cache index ``cached``.
-        On pool exhaustion, preempts the youngest-admitted request
+    def _grow_blocks(self, req: Request, extra: int = 1) -> bool:
+        """Ensure the request owns blocks through cache index
+        ``cached + extra - 1`` (plain decode writes one entry; a
+        speculative step writes up to ``live + 1``).  On pool
+        exhaustion, preempts the youngest-admitted request
         (recompute-style: blocks freed, request requeued; its sampling
         replays identically).  Returns False if ``req`` itself was
         preempted."""
-        while len(req.blocks) * self.alloc.block_size < req.cached + 1:
+        while len(req.blocks) * self.alloc.block_size < req.cached + extra:
             if self.alloc.can_alloc(1):
                 req.blocks += self.alloc.alloc(1, req.id)
                 continue
@@ -877,6 +1168,9 @@ class Engine:
         self.sched.requeue(victim)
 
     def _decode_step(self) -> None:
+        if self.spec is not None:
+            self._verify_step()
+            return
         # growth pass first: a preemption inside _grow_blocks mutates
         # sched.running, so the batch roster is only read afterwards
         # (a preempted victim must not decode on freed blocks).
@@ -932,6 +1226,124 @@ class Engine:
                 continue
             hist.observe(step_ms)
             self._append_token(req, int(toks[i]))
+
+    def _verify_step(self) -> None:
+        """The speculative replacement for :meth:`_decode_step`: draft
+        K tokens per row, verify all of them (plus the bonus position)
+        in ONE fixed-shape program, emit ``1..K+1`` tokens per row.
+
+        Per-row ``live`` (how many drafts are actually in play) is
+        clamped by the remaining token budget and — under pool
+        pressure — degraded to 0 rather than preempting a neighbor for
+        speculative headroom: a live=0 row runs the exact decode math
+        inside the verify shape, so speculation never changes WHAT is
+        emitted, only how many tokens arrive per step."""
+        k = self.spec_k
+        c = k + 1
+        for req in list(self.sched.running):
+            if (req not in self.sched.running
+                    or req.prefilled < req.prefill_target):
+                continue
+            live = max(min(k, req.max_new_tokens - len(req.tokens) - 1), 0)
+            need = (self.alloc.blocks_for_tokens(req.cached + live + 1)
+                    - len(req.blocks))
+            if live > 0 and need > 0 and not self.alloc.can_alloc(need):
+                live = 0      # no preemption for speculative headroom
+            if not self._grow_blocks(req, extra=live + 1):
+                continue
+            req.spec_live = live
+        active = [r for r in self.sched.running
+                  if r.prefilled >= r.prefill_target]
+        if not active:
+            return
+        bb = cc.bucket_for(len(active), self.decode_buckets)
+        self._ensure_program("verify", bb)
+        drafts = np.asarray(
+            self.spec.propose([r.seed_tokens for r in active], k),
+            np.int32)
+        # drafter hygiene: a wrong draft is wasted width, an
+        # out-of-range id would be an invalid embedding lookup
+        drafts = np.clip(drafts, 0, self.vocab - 1)
+        tokens = np.zeros((bb, c), np.int32)
+        tables = np.zeros((bb, self.max_blocks), np.int32)
+        lengths = np.zeros((bb,), np.int32)
+        live_v = np.zeros((bb,), np.int32)
+        active_m = np.zeros((bb,), np.bool_)
+        keys = np.zeros((bb, 2), np.uint32)
+        temps = np.zeros((bb,), np.float32)
+        topks = np.zeros((bb,), np.int32)
+        for i, req in enumerate(active):
+            tokens[i, 0] = req.tokens[-1]
+            tokens[i, 1:] = drafts[i]
+            tables[i, :len(req.blocks)] = req.blocks
+            lengths[i] = req.cached
+            live_v[i] = req.spec_live
+            active_m[i] = True
+            keys[i] = req.key
+            temps[i] = req.temperature
+            topks[i] = req.top_k
+        t0 = time.monotonic()
+        with telemetry.span("serve.decode", step=self.step_idx, bucket=bb,
+                            active=len(active), spec_k=k):
+            self.kpool, self.vpool, out, nem, oks = (
+                self._programs[("verify", bb)](
+                    self.kpool, self.vpool, self._step_params(), tokens,
+                    tables, lengths, live_v, active_m, keys, temps,
+                    topks))
+        out = np.asarray(out)
+        nem = np.asarray(nem)
+        oks = np.asarray(oks)
+        step_ms = (time.monotonic() - t0) * 1e3
+        self._decode_ms = (step_ms if self._decode_ms == 0.0
+                           else 0.8 * self._decode_ms + 0.2 * step_ms)
+        hist = telemetry.histogram("serve.token_ms")
+        drafted = int(np.sum(live_v[:len(active)]))
+        accepted = 0
+        emitted = 0
+        for i, req in enumerate(active):
+            n = int(nem[i])
+            req.cached += n          # cursor: +accepted drafts +1
+            if not bool(oks[i]):
+                self._fail_nan(req)
+                continue
+            accepted += n - 1
+            for j in range(n):
+                # multi-token burst: the step's latency lands on the
+                # first token; later burst tokens arrive back-to-back
+                # (that IS their inter-token latency — satellite of
+                # BENCH_r15, keeps p99 ITL honest)
+                hist.observe(step_ms if j == 0 else 0.0)
+                emitted += 1
+                self._append_token(req, int(out[i, j]))
+                if req.done():
+                    break
+        self._tps = 0.8 * self._tps + 0.2 * (emitted / max(len(active), 1))
+        self._spec_drafted += drafted
+        self._spec_accepted += accepted
+        telemetry.counter("serve.spec.steps").inc()
+        if drafted:
+            telemetry.counter("serve.spec.drafted").inc(drafted)
+        if accepted:
+            telemetry.counter("serve.spec.accepted").inc(accepted)
+        if self._spec_drafted:
+            telemetry.gauge("serve.spec.accept_rate").set(
+                self._spec_accepted / self._spec_drafted)
+
+    def _decode_backlog_ms(self) -> float:
+        """Expected wait until a decode slot frees, credited to queued
+        requests' SLO clocks when every slot is busy (the decode-side
+        sibling of :meth:`_prefill_backlog_ms`).  Speculation makes
+        this K-aware: a step emits ``_tps`` tokens per row on average,
+        so the soonest slot frees after ``remaining / _tps`` steps —
+        without the tokens-per-step term the scheduler would overstate
+        backlog by the acceptance rate and jump requests early."""
+        if self.spec is None or not self._decode_ms:
+            return 0.0
+        running = [r for r in self.sched.running]
+        if not running or len(running) < self.sched.max_batch:
+            return 0.0
+        rem = min(r.max_new_tokens - len(r.tokens) for r in running)
+        return (rem / max(self._tps, 1.0)) * self._decode_ms
 
     def _append_token(self, req: Request, tok: int) -> None:
         now = time.monotonic()
@@ -992,4 +1404,14 @@ class Engine:
             "prefill_chunk": self.prefill_chunk,
             "kv_quant": self.kv_quant,
             "attn_impl": self.attn_impl,
+            "speculate": (None if self.spec is None else {
+                "draft": self.spec.kind,
+                "k": self.spec_k,
+                "drafted": self._spec_drafted,
+                "accepted": self._spec_accepted,
+                "accept_rate": (self._spec_accepted / self._spec_drafted
+                                if self._spec_drafted else 0.0),
+                "tokens_per_step": self._tps,
+                "draft_swaps": getattr(self.spec, "swap_count", 0),
+            }),
         }

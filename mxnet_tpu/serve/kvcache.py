@@ -57,7 +57,8 @@ from .. import quant as quantmod
 __all__ = ["TRASH_BLOCK", "KV_QUANT_FORMATS", "QuantPool", "BlockAllocator",
            "make_pools", "is_quantized", "layer_view", "pool_nbytes",
            "kv_bytes_per_token", "paged_attention", "paged_prefill_attention",
-           "dense_attention", "write_prefill", "write_decode", "compact_pool"]
+           "paged_verify_attention", "dense_attention", "write_prefill",
+           "write_decode", "write_spec", "scrub_positions", "compact_pool"]
 
 #: physical slot 0 is never handed out: padded prefill positions and
 #: inactive decode rows scatter their garbage there, keeping every
@@ -414,6 +415,48 @@ def paged_prefill_attention(q, k_pool, v_pool, table_row, start, length, *,
     return (out / l[..., None]).astype(q.dtype)
 
 
+def paged_verify_attention(q, k_pool, v_pool, tables, lengths, *,
+                           scale: Optional[float] = None):
+    """Causal attention for one **speculative verify** step: C query
+    positions per request over a paged cache.
+
+    ``q``: [B, C, H, hd] — query states at absolute positions
+    ``lengths[b] .. lengths[b]+C-1`` (position 0 of the window is the
+    request's current last token, 1..C-1 the drafted continuation);
+    ``tables``: [B, max_blocks]; ``lengths``: [B] cache entries valid
+    *before* this step.  The window's own K/V must already be written
+    (the verify program writes them first, exactly like the decode and
+    chunk-prefill twins), so window position ``c`` may attend to every
+    cached position ``<= lengths+c``.  Returns [B, C, H, hd].
+
+    Materializes the [B, C, L_max] score matrix in one gather (the
+    "dense" decode strategy — C is small, K+1 window positions), with
+    the same f32 max/exp/sum masked-softmax math as
+    :func:`paged_attention` ``impl="dense"``.  A C=1 window reads the
+    cache as the decode step does up to gemm-scheduling ulps (XLA
+    contracts the [B, C, ...] einsum differently from the [B, ...]
+    one); stream-level greedy byte-identity is what the engine
+    guarantees, pinned by tests/test_speculate.py.
+    """
+    b, c, h, d = q.shape
+    nblk = tables.shape[1]
+    bs = _block_size_of(k_pool)
+    scale_ = (1.0 / np.sqrt(d)) if scale is None else scale
+    f32 = jnp.float32
+    k = _gather_blocks(k_pool, tables).reshape(b, nblk * bs, h, d)
+    v = _gather_blocks(v_pool, tables).reshape(b, nblk * bs, h, d)
+    s = jnp.einsum("bchd,blhd->bchl", q, k).astype(f32) * scale_
+    pos = jnp.arange(nblk * bs)
+    qpos = lengths[:, None] + jnp.arange(c)[None, :]          # [B, C]
+    valid = pos[None, None, :] <= qpos[:, :, None]            # [B, C, L]
+    s = jnp.where(valid[:, :, None, :], s, NEG_INF)
+    m = jnp.max(s, axis=-1)
+    p = jnp.where(valid[:, :, None, :], jnp.exp(s - m[..., None]), 0.0)
+    l = jnp.maximum(jnp.sum(p, axis=-1), 1e-30)
+    out = jnp.einsum("bchl,blhd->bchd", p, v.astype(f32))
+    return (out / l[..., None]).astype(q.dtype)
+
+
 def dense_attention(q, k_buf, v_buf, lengths, *, block_size: int,
                     scale: Optional[float] = None):
     """The dense (non-paged) counterpart: same block scan, but K/V come
@@ -480,6 +523,45 @@ def write_decode(pool, layer: int, states, slots, offsets, active):
         return QuantPool(pool.payload.at[layer, slot, offsets].set(q),
                          pool.scale.at[layer, slot, offsets].set(s))
     return pool.at[layer, slot, offsets].set(states)
+
+
+def write_spec(pool, layer: int, states, slots, offsets):
+    """Scatter one speculative-verify window's K or V states: C
+    positions per row.
+
+    ``states``: [B, C, H, hd]; ``slots``/``offsets``: [B, C] physical
+    block and in-block position per window entry.  The caller masks
+    dead entries (inactive rows, positions past the row's live draft
+    count) by pointing their slot at the trash block — the scatter
+    itself is unconditional, like :func:`write_decode`.  Quantized
+    pools quantize each position row independently (flattened to
+    ``[B*C, H, hd]`` so a position's fp8 payload+scale is a pure
+    function of its states, independent of the window shape — the
+    byte-identity contract of speculative decode depends on it).
+    """
+    if is_quantized(pool):
+        b, c = states.shape[:2]
+        q, s = quantmod.rowwise_quantize(
+            states.reshape((b * c,) + states.shape[2:]), KV_FP8_FORMAT)
+        return QuantPool(
+            pool.payload.at[layer, slots, offsets].set(
+                q.reshape(states.shape)),
+            pool.scale.at[layer, slots, offsets].set(s.reshape(b, c)))
+    return pool.at[layer, slots, offsets].set(states)
+
+
+def scrub_positions(pool, slots, offsets):
+    """Zero individual cache positions — payload and scales — across
+    every layer: the rejection path of speculative decode.  ``slots``/
+    ``offsets``: [B, C]; entries the caller wants to keep point at the
+    trash block (scrubbing trash is free).  A rejected draft's K/V must
+    not survive at a position the block cursor rolled back over: the
+    next append overwrites it, but until then masked attention lanes
+    still read it (multiply-by-zero — the PR-12 NaN lesson), and the
+    rollback contract is that truncated positions hold no stale state.
+    """
+    return jax.tree_util.tree_map(
+        lambda a: a.at[:, slots, offsets].set(0), pool)
 
 
 def scrub_blocks(pool, blocks):

@@ -165,7 +165,9 @@ class Router:
                  engine_config: Optional[EngineConfig] = None,
                  config: Optional[RouterConfig] = None, *,
                  chaos: Optional[Any] = None,
-                 clock=time.monotonic):
+                 clock=time.monotonic,
+                 draft_params: Optional[Dict[str, Any]] = None,
+                 draft_heads: Optional[int] = None):
         self.config = config or RouterConfig.from_env()
         self._clock = clock
         n = int(self.config.replicas)
@@ -178,9 +180,16 @@ class Router:
         if isinstance(chaos, chaos_mod.ChaosSpec):
             chaos = {chaos_mod.chaos_replica(): chaos}
         off = chaos_mod.ChaosSpec({})
+        # each replica gets its OWN drafter (draft weights are
+        # per-replica operands — rolling_swap(target="draft") deploys
+        # them replica-by-replica, independently of the target model)
+        self._draft_params = draft_params
+        self._draft_heads = draft_heads
         self.replicas = [
             Replica(idx=i, engine=Engine(params, engine_config,
-                                         chaos=chaos.get(i, off)))
+                                         chaos=chaos.get(i, off),
+                                         draft_params=draft_params,
+                                         draft_heads=draft_heads))
             for i in range(n)]
         self._hb = Heartbeat(self.config.heartbeat_timeout_ms, clock=clock)
         now = self._clock()
@@ -473,7 +482,8 @@ class Router:
                      engine_config: Optional[EngineConfig] = None,
                      allow_rebuild: Optional[bool] = None,
                      epoch: Optional[int] = None,
-                     max_steps: int = 100000) -> Dict[str, Any]:
+                     max_steps: int = 100000,
+                     target: str = "model") -> Dict[str, Any]:
         """Deploy new weights across the fleet with zero downtime
         (docs/train_serve.md): replica-by-replica, each behind a
         graceful drain, so **no in-flight stream ever sees a
@@ -504,12 +514,17 @@ class Router:
         for actual zero-downtime deploys.
         """
         from ..online.compat import check_compat, signature_of_params
+        if target not in ("model", "draft"):
+            raise MXNetError(f"rolling_swap target {target!r}: expected "
+                             "'model' or 'draft'")
         if allow_rebuild is None:
             allow_rebuild = bool(_env_int("MXNET_TPU_ONLINE_REBUILD", 1))
         if isinstance(params_or_source, str):
             from ..predictor import load_weights
             _, params_or_source, _, _ = load_weights(params_or_source,
                                                      epoch)
+        if target == "draft":
+            return self._rolling_swap_draft(params_or_source, max_steps)
         new_sig = signature_of_params(params_or_source)
         targets = [rep for rep in self.replicas if rep.state == HEALTHY]
         if not targets:
@@ -548,7 +563,9 @@ class Router:
                         rep.engine = Engine(
                             params_or_source,
                             engine_config or old.config,
-                            chaos=old.chaos or chaos_mod.ChaosSpec({}))
+                            chaos=old.chaos or chaos_mod.ChaosSpec({}),
+                            draft_params=self._draft_params,
+                            draft_heads=self._draft_heads)
                         rep.engine.warmup()
                         telemetry.counter("online.rebuilds").inc()
                     rep.state = HEALTHY
@@ -562,6 +579,45 @@ class Router:
                     "mode": mode, "ms": round(ms, 3)})
         return {"mode": mode, "replicas": [rep.idx for rep in targets],
                 "swap_ms": swap_ms, "report": report.to_dict()}
+
+    def _rolling_swap_draft(self, params: Dict[str, Any],
+                            max_steps: int) -> Dict[str, Any]:
+        """Deploy new DRAFT-model weights across the fleet —
+        ``rolling_swap(..., target="draft")``.  No drain is needed: the
+        draft model only *proposes* tokens, and the verify step's
+        acceptance rule owns the output, so a mid-stream draft change
+        can move acceptance rates but never the emitted stream (greedy)
+        or its distribution (temperature).  Each replica installs under
+        the lock (compat-checked operands, zero retraces); an
+        incompatible signature raises before any replica is touched."""
+        targets = [rep for rep in self.replicas if rep.state == HEALTHY]
+        if not targets:
+            raise MXNetError("rolling_swap: no healthy replica to swap")
+        for rep in targets:
+            spec = rep.engine.spec
+            if spec is None or spec.kind != "model":
+                raise MXNetError(
+                    f"rolling_swap(target='draft'): replica {rep.idx} "
+                    "has no model drafter (speculate off or "
+                    "spec_draft='ngram')")
+        swap_ms: List[float] = []
+        report: Dict[str, Any] = {}
+        with telemetry.span("online.rolling_swap", mode="draft",
+                            replicas=len(targets)):
+            for rep in targets:
+                t0 = time.perf_counter()
+                with self._lock:
+                    report = rep.engine.swap_draft_weights(params)
+                ms = (time.perf_counter() - t0) * 1e3
+                swap_ms.append(ms)
+                telemetry.histogram("online.swap_ms").observe(ms)
+                telemetry.flight_recorder().record({
+                    "kind": "online.swap", "replica": rep.idx,
+                    "mode": "draft", "ms": round(ms, 3)})
+        self._draft_params = params   # future rebuilds use the new drafts
+        return {"mode": "draft",
+                "replicas": [rep.idx for rep in targets],
+                "swap_ms": swap_ms, "report": report}
 
     # -- placement & shedding ----------------------------------------------
 

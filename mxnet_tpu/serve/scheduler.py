@@ -76,6 +76,9 @@ class Request:
     # once; a preempted request resets both and re-chunks on re-admit.
     prefilled: int = 0
     prefill_target: int = 0
+    # speculative decode (engine-managed): drafts in play for this
+    # row's next verify step (0 = plain decode shape)
+    spec_live: int = 0
     cancel_requested: bool = False
     finish_reason: Optional[str] = None
     submit_t: float = 0.0
@@ -161,16 +164,23 @@ class Scheduler:
         return wait >= slo * self.slo_admit_frac
 
     def admission_order(self, now: Optional[float] = None,
-                        prefill_backlog_ms: float = 0.0) -> List[Request]:
+                        prefill_backlog_ms: float = 0.0,
+                        decode_backlog_ms: float = 0.0) -> List[Request]:
         """Queue in the order admission will consider it: SLO-at-risk
         first (least remaining slack first), then FIFO.  Slack is
-        discounted by ``prefill_backlog_ms`` (see :meth:`_at_risk`)."""
+        discounted by ``prefill_backlog_ms`` plus ``decode_backlog_ms``
+        (see :meth:`_at_risk`) — the decode term is the wait for a busy
+        slot to free, which the engine computes K-aware under
+        speculative decoding (a step emits 1..K+1 tokens, so slot
+        turnover is ``remaining / tokens_per_step`` steps, not
+        ``remaining``)."""
         now = time.monotonic() if now is None else now
+        backlog = prefill_backlog_ms + decode_backlog_ms
 
         def sort_key(req):
-            if self._at_risk(req, now, prefill_backlog_ms):
+            if self._at_risk(req, now, backlog):
                 slack = (self._slo(req)
-                         - (now - req.submit_t) * 1e3 - prefill_backlog_ms)
+                         - (now - req.submit_t) * 1e3 - backlog)
                 return (0, slack, self._order[req.id])
             return (1, 0.0, self._order[req.id])
 
@@ -178,13 +188,15 @@ class Scheduler:
 
     def admit(self, can_place: Callable[[Request], bool],
               now: Optional[float] = None,
-              prefill_backlog_ms: float = 0.0) -> List[Request]:
+              prefill_backlog_ms: float = 0.0,
+              decode_backlog_ms: float = 0.0) -> List[Request]:
         """Move requests from the queue into free decode slots.  Stops
         at the first candidate ``can_place`` rejects (strict order —
         no starvation by smaller latecomers)."""
         now = time.monotonic() if now is None else now
         admitted: List[Request] = []
-        for req in self.admission_order(now, prefill_backlog_ms):
+        for req in self.admission_order(now, prefill_backlog_ms,
+                                        decode_backlog_ms):
             if len(self.running) >= self.max_batch:
                 break
             if not can_place(req):

@@ -767,3 +767,114 @@ def test_engine_config_validation_round12():
     with pytest.raises(MXNetError):
         _engine(prefill_chunk=-1)
     assert _engine(attn_impl="auto").attn_impl == "dense"  # CPU resolve
+
+
+# ---------------------------------------------------------------------------
+# Round-15 speculative-decode kvcache primitives: windowed write, verify
+# attention, rejected-tail scrub (the engine-level contracts live in
+# tests/test_speculate.py)
+# ---------------------------------------------------------------------------
+
+def test_write_spec_and_scrub_positions_roundtrip():
+    """write_spec lands a [B, C] window of positions; scrub_positions
+    zeroes exactly the rejected tail and leaves accepted neighbours —
+    including entries in the SAME block — untouched."""
+    BS, HD = 4, 2
+    pool = jnp.zeros((1, 6, BS, H, HD))
+    rng = np.random.RandomState(3)
+    states = jnp.asarray(rng.randn(2, 3, H, HD).astype(np.float32))
+    # row 0 writes block 2 offsets 1..3; row 1 straddles blocks 4 -> 5
+    slots = jnp.asarray([[2, 2, 2], [4, 4, 5]], jnp.int32)
+    offs = jnp.asarray([[1, 2, 3], [2, 3, 0]], jnp.int32)
+    out = kvcache.write_spec(pool, 0, states, slots, offs)
+    np.testing.assert_array_equal(np.asarray(out[0, 2, 1:4]),
+                                  np.asarray(states[0]))
+    np.testing.assert_array_equal(np.asarray(out[0, 4, 2:4]),
+                                  np.asarray(states[1, :2]))
+    np.testing.assert_array_equal(np.asarray(out[0, 5, 0]),
+                                  np.asarray(states[1, 2]))
+    # scrub row 0's last two positions and row 1's last one (kept
+    # positions redirect to the trash block, the engine's convention)
+    sslots = jnp.asarray([[TRASH_BLOCK, 2, 2],
+                          [TRASH_BLOCK, TRASH_BLOCK, 5]], jnp.int32)
+    scrubbed = kvcache.scrub_positions(out, sslots, offs)
+    assert not np.asarray(scrubbed[0, 2, 2:4]).any()   # rejected tail gone
+    assert not np.asarray(scrubbed[0, 5, 0]).any()
+    np.testing.assert_array_equal(                      # survivors intact
+        np.asarray(scrubbed[0, 2, 1]), np.asarray(states[0, 0]))
+    np.testing.assert_array_equal(
+        np.asarray(scrubbed[0, 4, 2:4]), np.asarray(states[1, :2]))
+
+
+def test_write_spec_fp8_matches_decode_write():
+    """fp8 pools quantize per position (the window is flattened before
+    rowwise_quantize), so a C-wide speculative write of one position is
+    byte-equal to the 1-wide decode write of the same state — the
+    quantization invariant greedy byte-identity rides on."""
+    from mxnet_tpu import quant as quantmod
+    BS, HD = 4, 2
+    fp8 = quantmod._FP8_DTYPES[kvcache.KV_FP8_FORMAT]
+    pool = kvcache.QuantPool(
+        payload=jnp.zeros((1, 6, BS, H, HD), fp8),
+        scale=jnp.zeros((1, 6, BS), jnp.float32))
+    rng = np.random.RandomState(5)
+    st = jnp.asarray(rng.randn(1, 3, H, HD).astype(np.float32))
+    slots = jnp.asarray([[2, 2, 2]], jnp.int32)
+    offs = jnp.asarray([[0, 1, 2]], jnp.int32)
+    wide = kvcache.write_spec(pool, 0, st, slots, offs)
+    via_decode = pool
+    for c in range(3):
+        via_decode = kvcache.write_decode(
+            via_decode, 0, st[:, c], jnp.asarray([2], jnp.int32),
+            jnp.asarray([c], jnp.int32), jnp.asarray([True]))
+    np.testing.assert_array_equal(np.asarray(wide.payload[0, 2, :3]),
+                                  np.asarray(via_decode.payload[0, 2, :3]))
+    np.testing.assert_array_equal(np.asarray(wide.scale[0, 2, :3]),
+                                  np.asarray(via_decode.scale[0, 2, :3]))
+    # scrub clears payload AND scale
+    sslots = jnp.asarray([[TRASH_BLOCK, 2, 2]], jnp.int32)
+    scrubbed = kvcache.scrub_positions(wide, sslots, offs)
+    assert not np.asarray(scrubbed.payload[0, 2, 1:3]).any()
+    assert not np.asarray(scrubbed.scale[0, 2, 1:3]).any()
+    assert np.asarray(scrubbed.scale[0, 2, 0]) == \
+        np.asarray(wide.scale[0, 2, 0])
+
+
+def test_paged_verify_attention_c1_matches_decode():
+    """A C=1 verify window reads the cache like the dense decode path
+    (same mask, same f32 softmax math; XLA schedules the extra window
+    axis' gemm differently, so equality is to ulps, not bits — the
+    engine's stream-level greedy byte-identity is pinned in
+    tests/test_speculate.py)."""
+    q, kd, vd, kp, vp, tables, lengths, BS = _paged_setup()
+    ref = np.asarray(kvcache.paged_attention(
+        jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+        jnp.asarray(tables), jnp.asarray(lengths), impl="dense"))
+    ver = np.asarray(kvcache.paged_verify_attention(
+        jnp.asarray(q)[:, None], jnp.asarray(kp), jnp.asarray(vp),
+        jnp.asarray(tables), jnp.asarray(lengths) - 1))
+    np.testing.assert_allclose(ver[:, 0], ref, rtol=1e-6, atol=1e-6)
+
+
+def test_paged_verify_attention_matches_reference():
+    """Each window position c attends over cache positions
+    0..lengths+c (causal within the window) — checked against a plain
+    softmax reference."""
+    q, kd, vd, kp, vp, tables, lengths, BS = _paged_setup()
+    C = 3
+    rng = np.random.RandomState(11)
+    qw = rng.randn(q.shape[0], C, H, q.shape[-1]).astype(np.float32)
+    base = lengths - C                 # cache holds the window's K/V too
+    ver = np.asarray(kvcache.paged_verify_attention(
+        jnp.asarray(qw), jnp.asarray(kp), jnp.asarray(vp),
+        jnp.asarray(tables), jnp.asarray(base)))
+    for b in range(q.shape[0]):
+        for c in range(C):
+            L = int(base[b]) + c + 1
+            s = np.einsum("hd,lhd->hl", qw[b, c], kd[b, :L])
+            s /= np.sqrt(q.shape[-1])
+            p = np.exp(s - s.max(-1, keepdims=True))
+            p /= p.sum(-1, keepdims=True)
+            ref = np.einsum("hl,lhd->hd", p, vd[b, :L])
+            np.testing.assert_allclose(ver[b, c], ref, rtol=1e-5,
+                                       atol=1e-6)

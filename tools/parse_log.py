@@ -308,6 +308,10 @@ SERVE_LAT_SLACK_MS = 2.0
 # intervals does — gate only a blow-up, not jitter
 SWAP_MS_GROWTH = 0.50
 SWAP_MS_SLACK = 25.0
+# speculative acceptance is a property of drafter + workload, not of
+# host load: a real drop means the drafter (or the acceptance rule)
+# changed behavior.  Gate absolute drops beyond this, not noise.
+SPEC_ACCEPT_DROP = 0.10
 
 
 def diff_serve(path_a, path_b):
@@ -329,7 +333,14 @@ def diff_serve(path_a, path_b):
     swap must have run zero post-warmup retraces (a retracing "hot"
     swap is the bug the whole design exists to prevent), and the
     per-replica swap latency may not blow up between reports (growth
-    over ``SWAP_MS_GROWTH`` beyond the absolute slack)."""
+    over ``SWAP_MS_GROWTH`` beyond the absolute slack).
+
+    Speculative rows (``bench.py --serve --speculate``, BENCH_r15)
+    gate the round-15 contract: the accept-friendly row must keep its
+    own >= 2x pass, greedy streams must stay byte-identical to the
+    non-speculative engine, zero post-warmup retraces, acceptance rate
+    may not drop more than ``SPEC_ACCEPT_DROP`` absolute, and the
+    speedup ratio gets the ``SERVE_TOKENS_TOL`` noise floor."""
     a, b = read_serve(path_a), read_serve(path_b)
     common = [m for m in a if m in b]
     if not common:
@@ -396,6 +407,38 @@ def diff_serve(path_a, path_b):
             if pct > SWAP_MS_GROWTH and sb - sa > SWAP_MS_SLACK:
                 worse.append(f"{metric}: swap latency grew "
                              f"{100 * pct:.0f}% ({sa:g} -> {sb:g} ms)")
+    for metric, rec in b.items():
+        if "speculative" not in metric:
+            continue
+        # the BENCH_r15 contract: the gated accept-friendly row keeps
+        # its >= 2x bar (the row's own "pass"), greedy streams stay
+        # byte-identical to the non-speculative engine, nothing
+        # retraces post-warmup, and acceptance — a drafter-behavior
+        # property, not a load-wobble one — may not fall more than
+        # SPEC_ACCEPT_DROP absolute between reports.  The speedup
+        # ratio itself gets the same noise floor as raw tokens/s.
+        if rec.get("pass") is False:
+            worse.append(f"{metric}: speculative row failed its own "
+                         "gate in report B")
+        if rec.get("temperature") == 0 \
+                and rec.get("streams_identical") is False:
+            worse.append(f"{metric}: greedy speculative streams "
+                         "diverged from the non-speculative engine "
+                         "(replay-exactness broken)")
+        if rec.get("new_traces", 0) != 0:
+            worse.append(f"{metric}: speculative scenario retraced "
+                         f"{rec.get('new_traces')} programs post-warmup")
+        ra = a.get(metric, {})
+        aa, ab = ra.get("accept_rate"), rec.get("accept_rate")
+        if aa is not None and ab is not None \
+                and aa - ab > SPEC_ACCEPT_DROP:
+            worse.append(f"{metric}: acceptance rate fell {aa:g} -> "
+                         f"{ab:g} (> {SPEC_ACCEPT_DROP:g} absolute)")
+        sa, sb = ra.get("value"), rec.get("value")
+        if sa and sb is not None \
+                and (sb - sa) / sa < -SERVE_TOKENS_TOL:
+            worse.append(f"{metric}: speculative speedup fell "
+                         f"{sa:g}x -> {sb:g}x")
     for msg in worse:
         print(f"REGRESSED: {msg}", file=sys.stderr)
     return 1 if worse else 0

@@ -25,7 +25,14 @@ and asserts:
    update publishes through CheckpointManager with the compat stamp,
    and ``Router.rolling_swap`` deploys it under 8 live streams —
    mode ``hot``, zero retraces, every stream finishes, no KV leak,
-   and ``online.swaps`` == replica count.
+   and ``online.swaps`` == replica count;
+6. round-15 speculative decoding holds its contract under the same
+   traffic: a ``speculate=True`` engine (n-gram drafter, k=4, fp8 KV)
+   warms the verify program family INSTEAD of decode, is warm after
+   step 1, finishes all 8 streams with greedy rows byte-identical to
+   the plain engine, advances ``serve.spec.steps`` /
+   ``serve.spec.accepted``, and drains the pool to zero used blocks
+   (the rejected-tail scrub keeps the block ledger exact).
 
 Exit 0 on success, 1 with a reason on any failure.  Runs on the CPU
 mesh in a few seconds; invoked by tools/ci_check.sh after the
@@ -245,6 +252,53 @@ def main() -> None:
         fail("online.swap_ms histogram missing per-replica swap latency")
     swap_ms = summary["swap_ms"]
 
+    # --- 6. speculative decoding (docs/serving.md, round 15) --------
+    # the same 8 streams through a speculate=True engine (n-gram
+    # drafter, fp8 KV): warm after step 1 — the verify program replaces
+    # the decode family in the warmup set — greedy streams
+    # byte-identical to the plain engine from section 1, acceptance
+    # telemetry advancing, and the pool drains (rejected-tail scrub
+    # keeps the block ledger exact).
+    spec_eng = Engine(params, EngineConfig(
+        heads=H, block_size=4, num_blocks=64, max_batch=8,
+        max_prompt_len=16, max_seq_len=48, prompt_bucket_min=8,
+        prefill_chunk=8, kv_quant="fp8", speculate=True, spec_k=4))
+    spec_eng.warmup()
+    kinds = {k for k, _ in spec_eng._programs}
+    if "verify" not in kinds or "decode" in kinds:
+        fail(f"speculative warmup compiled {sorted(kinds)}; expected "
+             "the verify family to REPLACE decode")
+    sids = [spec_eng.submit(p, max_new_tokens=m,
+                            temperature=0.8 * (i % 2), seed=i)
+            for i, (p, m) in enumerate(zip(prompts, budgets))]
+    spec_warm = dict(spec_eng.trace_counts)
+    spec_eng.step()
+    if dict(spec_eng.trace_counts) != spec_warm:
+        fail(f"speculative step 1 retraced: "
+             f"{dict(spec_eng.trace_counts)} != {spec_warm}")
+    spec_eng.run()
+    if dict(spec_eng.trace_counts) != spec_warm:
+        fail("speculative engine not warm after step 1: "
+             f"{dict(spec_eng.trace_counts)} vs {spec_warm}")
+    for i, (sid, rid) in enumerate(zip(sids, ids)):
+        sreq = spec_eng.requests[sid]
+        if sreq.state != "finished":
+            fail(f"speculative stream {sid} ended {sreq.state!r}")
+        if i % 2 == 0 and sreq.tokens != eng.requests[rid].tokens:
+            fail(f"greedy stream {i} diverged under speculation: "
+                 f"{sreq.tokens} != {eng.requests[rid].tokens}")
+    if spec_eng.alloc.num_used != 0:
+        fail(f"speculative engine leaked {spec_eng.alloc.num_used} "
+             "KV blocks (rejected-tail scrub / cursor rollback broken)")
+    flat = telemetry.snapshot_flat()
+    spec_acc = int(flat.get("serve.spec.accepted", 0))
+    if not flat.get("serve.spec.steps"):
+        fail("serve.spec.steps counter never advanced")
+    if spec_acc <= 0:
+        fail("serve.spec.accepted never advanced (drafter accepted "
+             "nothing on cycling greedy streams)")
+    spec_stats = spec_eng.stats()["speculate"]
+
     print(f"serve_smoke: OK (8 streams, {want} tokens, "
           f"hot-swap {len(swap_ms)} replicas "
           f"[{', '.join(f'{m:.0f}ms' for m in swap_ms)}] under load, "
@@ -253,7 +307,9 @@ def main() -> None:
           f"{sum(traces_warm.values())} at warmup + 0 after, "
           f"{info['events']} trace events, "
           f"{int(flat.get('serve.router.failovers', 0))} failovers "
-          "byte-identical, dir={0})".format(tmp))
+          f"byte-identical, speculative k={spec_stats['k']} "
+          f"accept={spec_stats['accept_rate']:.2f} "
+          f"({spec_acc} drafts landed), dir={{0}})".format(tmp))
 
 
 if __name__ == "__main__":
