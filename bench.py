@@ -927,6 +927,19 @@ def bench_serve(args):
     its own informational column) with byte-identical greedy streams
     and zero post-warmup traces; the adversarial row is informational
     (acceptance-rate column, graceful degradation).
+
+    With ``--prefix`` (ISSUE 19) the cross-request prefix-cache
+    scenario rides along and the report lands in ``BENCH_r16.json``: a
+    shared-prefix trace (48-token system prompt + 4-token suffixes,
+    a concurrent mixed greedy/seeded wave, a multi-turn second wave,
+    and a serial cached-TTFT sweep) runs on a ``prefix_cache=True``
+    engine and again cache-off.  The gated row requires >= 1.5x the
+    cache-off tokens/s, median cached TTFT <= 2x the median
+    inter-token latency (a warm prefill is ONE suffix chunk), streams
+    byte-identical between the two runs, zero post-warmup traces, and
+    a clean block ledger (no leak, cached blocks parked refcount-0).
+    ``parse_log.py --diff-serve`` gates cached-TTFT growth and
+    absolute hit-rate drops between reports.
     """
     import jax
     from mxnet_tpu.models.transformer import transformer_lm
@@ -1299,9 +1312,121 @@ def bench_serve(args):
                     spec["spec"]["tokens_per_step"] >= 1.0 and zero)
             rows.append(row)
             _emit_row(row)
+    if getattr(args, "prefix", False):
+        # shared-prefix workload (ISSUE 19): a 48-token system prompt
+        # (3 full 16-token blocks) in front of tiny per-stream
+        # suffixes, plus a multi-turn second wave and a serial
+        # cached-TTFT sweep.  The same trace runs cache-on and
+        # cache-off; byte-identity between them is the correctness
+        # gate, the tokens/s ratio and cached TTFT are the perf gates.
+        pfx_cfg = dict(heads=H, block_size=16, num_blocks=256,
+                       max_batch=8, max_queue=64, max_prompt_len=64,
+                       max_seq_len=128, prompt_bucket_min=16,
+                       prefill_chunk=16)
+        pr = np.random.RandomState(4)
+        sys_prompt = [int(t) for t in pr.randint(1, V, 48)]
+        wave1 = [sys_prompt + [int(t) for t in pr.randint(1, V, 4)]
+                 for _ in range(8)]
+        kw1 = [dict(max_new_tokens=8, temperature=(0.8 if i % 2 else 0.0),
+                    top_k=(40 if i % 2 else 0), seed=700 + i)
+               for i in range(8)]
+        sweep_sfx = [[int(t) for t in np.random.RandomState(90 + j)
+                      .randint(1, V, 4)] for j in range(6)]
+
+        def prefix_drive(prefix_cache):
+            eng = Engine(params, EngineConfig(prefix_cache=prefix_cache,
+                                              **pfx_cfg))
+            eng.warmup()
+            warm = dict(eng.trace_counts)
+            t0 = time.perf_counter()
+            ids = [eng.submit(p, **kw) for p, kw in zip(wave1, kw1)]
+            eng.run()
+            # wave 2, multi-turn: each conversation resubmits its full
+            # first-turn history plus fresh user tokens — only the
+            # shared system prompt's blocks are cache-resident
+            wave2 = [list(eng.requests[i].prompt)
+                     + list(eng.requests[i].tokens)
+                     + [int(t) for t in np.random.RandomState(50 + j)
+                        .randint(1, V, 4)]
+                     for j, i in enumerate(ids)]
+            ids2 = [eng.submit(p, max_new_tokens=8,
+                               temperature=(0.7 if j % 2 else 0.0),
+                               top_k=(40 if j % 2 else 0), seed=800 + j)
+                    for j, p in enumerate(wave2)]
+            eng.run()
+            # serial sweep: one warm request at a time — the clean
+            # cached-TTFT number, no queueing in front of it
+            ttft = []
+            ids3 = []
+            for j, sfx in enumerate(sweep_sfx):
+                rid = eng.submit(sys_prompt + sfx, max_new_tokens=4,
+                                 seed=900 + j)
+                eng.run()
+                q = eng.requests[rid]
+                ttft.append(1e3 * (q.first_token_t - q.submit_t))
+                ids3.append(rid)
+            wall = time.perf_counter() - t0
+            done = [eng.requests[i] for i in ids + ids2 + ids3]
+            total = sum(len(q.tokens) for q in done)
+            intervals = [1e3 * (b - a) for q in done
+                         for a, b in zip(q.token_times,
+                                         q.token_times[1:])]
+            eng.check_tables()
+            return {
+                "tokens_s": total / wall,
+                "tokens": total,
+                "wall_s": wall,
+                "ttft_ms": float(np.median(ttft)),
+                "itl_ms": float(np.median(intervals)),
+                "streams": [q.tokens for q in done],
+                "new_traces": sum(dict(eng.trace_counts).values())
+                - sum(warm.values()),
+                "kv_leak": eng.alloc.num_used,
+                "prefix": eng.stats()["prefix"],
+            }
+
+        on = prefix_drive(True)
+        off = prefix_drive(False)
+        ratio = on["tokens_s"] / off["tokens_s"]
+        ident = bool(on["streams"] == off["streams"])
+        zero = (on["new_traces"] == 0 and off["new_traces"] == 0)
+        clean = (on["kv_leak"] == 0 and off["kv_leak"] == 0)
+        ttft_ok = on["ttft_ms"] <= 2.0 * on["itl_ms"]
+        pst = on["prefix"]
+        row = {
+            "metric": f"serve prefix cache shared-prefix (48-token "
+                      f"system prompt, 2 waves + serial sweep, {dev})",
+            "value": round(ratio, 2),
+            "unit": "x tokens/s vs cache-off same-run",
+            "vs_baseline": None,
+            "tokens_s": round(on["tokens_s"], 1),
+            "base_tokens_s": round(off["tokens_s"], 1),
+            "cached_ttft_ms": round(on["ttft_ms"], 2),
+            "cold_ttft_ms": round(off["ttft_ms"], 2),
+            "p50_token_ms": round(on["itl_ms"], 2),
+            "hit_rate": round(pst["hit_rate"], 3),
+            "hits": pst["hits"],
+            "misses": pst["misses"],
+            "hit_tokens": pst["hit_tokens"],
+            "cached_blocks": pst["cached_blocks"],
+            "streams_identical": ident,
+            "new_traces": on["new_traces"] + off["new_traces"],
+            "kv_leak": on["kv_leak"] + off["kv_leak"],
+            "wall_s": round(on["wall_s"], 2),
+            "tokens": on["tokens"],
+            "n_devices": len(jax.devices()),
+            "target": (">= 1.5x cache-off tokens/s, cached TTFT <= 2x "
+                       "median ITL, streams byte-identical, zero "
+                       "post-warmup traces, block ledger clean"),
+            "pass": bool(ratio >= 1.5 and ttft_ok and ident and zero
+                         and clean),
+        }
+        rows.append(row)
+        _emit_row(row)
     out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                       "BENCH_r15.json" if getattr(args, "speculate",
-                                                   False)
+                       "BENCH_r16.json" if getattr(args, "prefix", False)
+                       else "BENCH_r15.json"
+                       if getattr(args, "speculate", False)
                        else "BENCH_r13.json"
                        if getattr(args, "hotswap", False)
                        else "BENCH_r12.json"
@@ -1703,6 +1828,11 @@ def main():
                     "scenario (n-gram draft + K-token verify; "
                     "accept-friendly and adversarial rows, acceptance "
                     "rate, greedy byte-identity) -> BENCH_r15.json")
+    ap.add_argument("--prefix", action="store_true",
+                    help="--serve: add the cross-request prefix-cache "
+                    "scenario (shared system prompt + multi-turn "
+                    "waves, cache-on vs cache-off; cached TTFT, hit "
+                    "rate, byte-identity) -> BENCH_r16.json")
     ap.add_argument("--elastic", action="store_true",
                     help="elastic-training scenario (docs/elastic.md): "
                     "in-process 8->4->8 live mesh resize (drain + "

@@ -110,10 +110,15 @@ class EngineConfig:
     spec_k: int = 4               # drafted tokens per verify window
     spec_draft: str = "ngram"     # "ngram" (prompt lookup) | "model"
     spec_window: int = 16         # model drafter's context window
+    # -- round-18 cross-request prefix cache (docs/serving.md) --
+    prefix_cache: bool = False    # content-hashed KV block reuse
+    prefix_cap_frac: float = 0.5  # max fraction of the pool parked as
+                                  # refcount-0 cached prefix blocks
+    prefix_min_blocks: int = 1    # shortest prefix hit worth mapping
 
     @classmethod
     def from_env(cls, **overrides) -> "EngineConfig":
-        """Environment defaults (docs/env_vars.md rounds 11-12, 17);
+        """Environment defaults (docs/env_vars.md rounds 11-12, 17-18);
         explicit kwargs win."""
         env = dict(
             block_size=_env_int("MXNET_TPU_SERVE_BLOCK_SIZE", 16),
@@ -132,6 +137,11 @@ class EngineConfig:
             spec_k=_env_int("MXNET_TPU_SERVE_SPEC_K", 4),
             spec_draft=(os.environ.get("MXNET_TPU_SERVE_SPEC_DRAFT", "")
                         .strip().lower() or "ngram"),
+            prefix_cache=bool(_env_int("MXNET_TPU_SERVE_PREFIX_CACHE", 0)),
+            prefix_cap_frac=_env_float(
+                "MXNET_TPU_SERVE_PREFIX_CAP_FRAC", 0.5),
+            prefix_min_blocks=_env_int(
+                "MXNET_TPU_SERVE_PREFIX_MIN_BLOCKS", 1),
         )
         env.update(overrides)
         return cls(**env)
@@ -359,6 +369,43 @@ class Engine:
             raise MXNetError(f"prefill_chunk must be >= 0, "
                              f"got {self.prefill_chunk}")
         self.alloc = kvcache.BlockAllocator(config.num_blocks, bs)
+        # -- round-18 cross-request prefix cache --
+        self.prefix: Optional[kvcache.PrefixIndex] = None
+        self._prefix_hits = 0
+        self._prefix_misses = 0
+        self._prefix_hit_tokens = 0
+        self._prefix_evictions = 0
+        if config.prefix_cache:
+            if not self.prefill_chunk:
+                raise MXNetError(
+                    "prefix_cache requires chunked prefill "
+                    "(prefill_chunk > 0): cache hits skip whole chunks")
+            if not (0.0 < config.prefix_cap_frac <= 1.0):
+                raise MXNetError(
+                    f"prefix_cap_frac must be in (0, 1], "
+                    f"got {config.prefix_cap_frac}")
+            if config.prefix_min_blocks < 1:
+                raise MXNetError(
+                    f"prefix_min_blocks must be >= 1, "
+                    f"got {config.prefix_min_blocks}")
+            self.prefix = kvcache.PrefixIndex(bs)
+            # hits are floored to a multiple of lcm(block, chunk): the
+            # warm run's remaining chunks then land on the SAME chunk
+            # grid a cold prefill uses, so every suffix chunk is the
+            # identical program invocation and the stream stays
+            # byte-identical to a cache-cold run by construction
+            self._hit_quantum = (bs * self.prefill_chunk
+                                 // np.gcd(bs, self.prefill_chunk))
+            self.alloc.cache_cap = max(
+                1, int(config.prefix_cap_frac * (config.num_blocks - 1)))
+            self.alloc.cache_filter = self.prefix.contains_block
+
+            def _on_evict(block: int) -> None:
+                self.prefix.drop_block(block)
+                self._prefix_evictions += 1
+                telemetry.counter("serve.prefix.evictions").inc()
+
+            self.alloc.on_evict = _on_evict
         self.kpool, self.vpool = kvcache.make_pools(
             self.num_layers, config.num_blocks, bs, self.heads,
             self.head_dim, dtype=config.dtype, quant=config.kv_quant)
@@ -488,6 +535,15 @@ class Engine:
         # the NaN-poison cache was derived from the OLD weights; a
         # later serve_poison_logits must poison the CURRENT ones
         self._poison_params = None
+        # prefix-cache invalidation: resident KV was computed under the
+        # OLD weights, so every index entry is stale.  The version bump
+        # makes stale hashes unreachable; ref-0 cached blocks go
+        # straight back to the free list (still-referenced shares just
+        # stop being cacheable — they free when their holders finish).
+        # Draft swaps (swap_draft_weights) deliberately do NOT pass
+        # through here: the draft model never writes target KV.
+        if self.prefix is not None:
+            self.alloc.uncache(self.prefix.invalidate())
         self.swap_count += 1
         telemetry.counter("online.swaps").inc()
         return report.to_dict()
@@ -955,6 +1011,9 @@ class Engine:
         telemetry.gauge("serve.queue_depth").set(self.sched.queue_depth)
         telemetry.gauge("serve.active_slots").set(self.sched.active)
         telemetry.gauge("serve.kv_blocks_used").set(self.alloc.num_used)
+        if self.prefix is not None:
+            telemetry.gauge("serve.prefix.cached_frac").set(
+                self.alloc.num_cached / (self.config.num_blocks - 1))
         telemetry.flight_recorder().record({
             "kind": "serve", "step": self.step_idx,
             "active": self.sched.active, "queued": self.sched.queue_depth,
@@ -1000,26 +1059,132 @@ class Engine:
         # rows wrote this step) may hold NaN — scrub before the blocks
         # go back to the pool, or the residue leaks into the next
         # request that reuses them (masked attention lanes multiply by
-        # zero, and 0 * NaN = NaN)
-        scrub = list(req.blocks) + [kvcache.TRASH_BLOCK]
+        # zero, and 0 * NaN = NaN).  Blocks another owner still
+        # references, and blocks published to the prefix index, are
+        # NOT scrubbed: a shared/indexed block is provably clean (it
+        # was published only after a finite-ok chunk and is never
+        # written again — this request's poisoned writes all landed in
+        # its private unpublished blocks), and zeroing it would corrupt
+        # the co-owner's stream.  This request merely drops its
+        # references via _finish.
+        scrub = [b for b in req.blocks
+                 if self.alloc.refcount(b) <= 1
+                 and (self.prefix is None
+                      or not self.prefix.contains_block(b))]
+        scrub += [kvcache.TRASH_BLOCK]
         self.kpool = kvcache.scrub_blocks(self.kpool, scrub)
         self.vpool = kvcache.scrub_blocks(self.vpool, scrub)
         self._finish(req, "error", FAILED)
 
+    # -- prefix cache (round 18) ------------------------------------------
+
+    def _probe(self, tokens: Sequence[int]) -> List[int]:
+        """Longest usable cached prefix of ``tokens``: physical blocks
+        from the index, floored to the hit quantum (chunk-grid
+        alignment — see ``__init__``) and capped strictly below
+        ``len(tokens)`` so at least one suffix chunk always runs (the
+        final chunk is what samples the first token)."""
+        if self.prefix is None:
+            return []
+        blocks = self.prefix.match(tokens)
+        bs = self.alloc.block_size
+        hit = min(len(blocks) * bs, len(tokens) - 1)
+        hit -= hit % self._hit_quantum
+        nblk = hit // bs
+        if nblk < self.config.prefix_min_blocks:
+            return []
+        return blocks[:nblk]
+
+    def prefix_probe(self, tokens: Sequence[int]) -> int:
+        """Tokens of ``tokens`` this engine could serve from its prefix
+        cache right now (0 when the cache is off).  Read-only — no
+        pinning — the router's affinity dispatch calls this on every
+        healthy replica."""
+        if self.prefix is None:
+            return 0
+        return len(self._probe([int(t) for t in tokens])) \
+            * self.alloc.block_size
+
+    def _count_prefix_hit(self, req: Request, nblocks: int) -> None:
+        bs = self.alloc.block_size
+        self._prefix_hits += 1
+        self._prefix_hit_tokens += nblocks * bs
+        telemetry.counter("serve.prefix.hits").inc()
+        telemetry.counter("serve.prefix.shared_blocks").inc(nblocks)
+        telemetry.counter("serve.prefix.hit_tokens").inc(nblocks * bs)
+
+    def _publish_prefix(self, req: Request) -> None:
+        """Publish every newly-completed *full* prefill block of
+        ``req`` to the index.  Called only after a finite-ok chunk and
+        never on a poison step, so indexed blocks are provably clean:
+        a full block is never written again (decode/spec writes land at
+        positions past the prefill target)."""
+        if self.prefix is None or self._poison_step:
+            return
+        n_full = min(req.prefilled, req.prefill_target) \
+            // self.alloc.block_size
+        if n_full <= req.published:
+            return
+        toks = req.seed_tokens[:n_full * self.alloc.block_size]
+        hashes = self.prefix.chain_hashes(toks)
+        for j in range(req.published, n_full):
+            self.prefix.publish(hashes[j], req.blocks[j])
+        req.published = n_full
+
+    def _map_prefix_second_chance(self, req: Request) -> None:
+        """Re-probe just before the FIRST prefill chunk runs.  A cohort
+        admitted in one step probes an index that none of them has
+        populated yet; by the time the pump reaches request N, request
+        0 may have prefilled and published the shared prefix — this is
+        what makes "8 streams, one prefill of the prefix" hold even for
+        same-step arrivals (and gives re-prefill-after-preemption and
+        adopted failover continuations their cached TTFT)."""
+        hits = self._probe(req.seed_tokens)
+        if not hits:
+            self._prefix_misses += 1
+            telemetry.counter("serve.prefix.misses").inc()
+            return
+        n = len(hits)
+        for b in hits:
+            self.alloc.addref(b, req.id)
+        drop = req.blocks[:n]
+        req.blocks = hits + req.blocks[n:]
+        # the dropped fresh blocks are unwritten and unindexed, so
+        # release sends them straight back to the free list
+        self.alloc.release(drop, req.id)
+        req.prefilled = req.cached = n * self.alloc.block_size
+        req.prefix_hit = n * self.alloc.block_size
+        req.published = n
+        self._count_prefix_hit(req, n)
+
+    # -- admission ---------------------------------------------------------
+
     def _admission_gate(self):
         """``can_place`` for one admit pass.  Blocks promised to earlier
-        accepted candidates are reserved against the free count, so two
-        requests admitted in the same pass can never jointly claim more
-        blocks than the pool has (their ``_prefill`` allocs all
-        succeed)."""
+        accepted candidates are reserved against the available count, so
+        two requests admitted in the same pass can never jointly claim
+        more blocks than the pool has (their ``_prefill`` allocs all
+        succeed).  With the prefix cache on, the candidate's longest
+        cached prefix is pinned (addref) and *discounted from the
+        reserve* — cache-satisfiable blocks cost nothing — and the
+        budget is ``num_available`` (free + evictable cached): parked
+        prefix blocks are extra capacity, never admission pressure."""
         reserved = 0
 
         def can_place(req: Request) -> bool:
             nonlocal reserved
-            need = self.alloc.blocks_for_tokens(len(req.seed_tokens))
-            if reserved + need > self.alloc.num_free:
+            toks = req.seed_tokens
+            total = self.alloc.blocks_for_tokens(len(toks))
+            hits = self._probe(toks)
+            for b in hits:
+                self.alloc.addref(b, req.id)
+            need = total - len(hits)
+            if reserved + need > self.alloc.num_available:
+                if hits:       # roll the pins back — admission stops
+                    self.alloc.release(hits, req.id)
                 return False
             reserved += need
+            req.prefix_blocks = hits
             return True
 
         return can_place
@@ -1058,13 +1223,22 @@ class Engine:
     def _prefill_begin(self, req: Request) -> None:
         """Admit-time half of chunked prefill: reserve the blocks the
         whole prompt needs (the admission gate already accounted for
-        them) and arm the chunk pump; no device work yet."""
+        them) and arm the chunk pump; no device work yet.  Blocks the
+        admission gate pinned from the prefix index slot in as the
+        table's leading entries — their tokens count as already
+        prefilled, so the pump starts at the first uncached chunk."""
         toks = req.seed_tokens
         req.prefill_target = len(toks)
-        req.prefilled = 0
-        req.cached = 0
-        req.blocks = self.alloc.alloc(
-            self.alloc.blocks_for_tokens(len(toks)), req.id)
+        hits = req.prefix_blocks
+        req.prefix_blocks = []
+        fresh = self.alloc.alloc(
+            self.alloc.blocks_for_tokens(len(toks)) - len(hits), req.id)
+        req.blocks = hits + fresh
+        req.prefilled = req.cached = len(hits) * self.alloc.block_size
+        req.prefix_hit = req.prefilled
+        req.published = len(hits)
+        if hits:
+            self._count_prefix_hit(req, len(hits))
 
     def _prefill_pump(self) -> None:
         """Run prefill chunks for mid-prefill requests, oldest first.
@@ -1092,6 +1266,8 @@ class Engine:
 
     def _prefill_chunk_step(self, req: Request) -> None:
         cb = self.prefill_chunk
+        if self.prefix is not None and req.prefilled == 0:
+            self._map_prefix_second_chance(req)
         start = req.prefilled
         plen = req.prefill_target
         toks = req.seed_tokens[start:start + cb]
@@ -1121,6 +1297,7 @@ class Engine:
             # K/V — fail now rather than stream garbage at the end
             self._fail_nan(req)
             return
+        self._publish_prefix(req)
         if req.prefilled >= plen:
             telemetry.counter("serve.prefills").inc()
             self._append_token(req, int(tok))
@@ -1160,11 +1337,18 @@ class Engine:
 
     def _preempt(self, victim: Request) -> None:
         telemetry.counter("serve.preemptions").inc()
-        self.alloc.free(victim.blocks)
+        # drop references, don't force-free: a shared prefix block must
+        # survive for its co-owners, and this victim's own published
+        # blocks park in the cache — its re-prefill re-probes the index
+        # and gets most of its context back at cached-TTFT cost
+        self.alloc.release(victim.blocks, victim.id)
         victim.blocks = []
         victim.cached = 0
         victim.prefilled = 0
         victim.prefill_target = 0
+        victim.prefix_blocks = []
+        victim.prefix_hit = 0
+        victim.published = 0
         self.sched.requeue(victim)
 
     def _decode_step(self) -> None:
@@ -1363,8 +1547,16 @@ class Engine:
                 state: str = FINISHED) -> None:
         self.sched.finish(req, reason, state)
         if req.blocks:
-            self.alloc.free(req.blocks)
+            # reference drop, not force-free: shared prefix blocks stay
+            # for their co-owners, published blocks park in the LRU
+            # cache for the next request with this prefix
+            self.alloc.release(req.blocks, req.id)
             req.blocks = []
+        if req.prefix_blocks:
+            # admission pinned a prefix but the request died before
+            # _prefill_begin consumed it (deadline/cancel sweep)
+            self.alloc.release(req.prefix_blocks, req.id)
+            req.prefix_blocks = []
         telemetry.counter("serve.evictions").inc(reason=reason)
 
     # -- maintenance / introspection ---------------------------------------
@@ -1379,6 +1571,8 @@ class Engine:
             self.vpool = kvcache.compact_pool(self.vpool, mapping)
             for req in self.sched.running:
                 req.blocks = [mapping.get(b, b) for b in req.blocks]
+            if self.prefix is not None:
+                self.prefix.remap(mapping)
         return len(mapping)
 
     def check_tables(self) -> None:
@@ -1404,6 +1598,19 @@ class Engine:
             "prefill_chunk": self.prefill_chunk,
             "kv_quant": self.kv_quant,
             "attn_impl": self.attn_impl,
+            "prefix": (None if self.prefix is None else {
+                "entries": len(self.prefix),
+                "version": self.prefix.version,
+                "cached_blocks": self.alloc.num_cached,
+                "hits": self._prefix_hits,
+                "misses": self._prefix_misses,
+                "hit_tokens": self._prefix_hit_tokens,
+                "evictions": self._prefix_evictions,
+                "hit_rate": (self._prefix_hits
+                             / (self._prefix_hits + self._prefix_misses)
+                             if self._prefix_hits + self._prefix_misses
+                             else 0.0),
+            }),
             "speculate": (None if self.spec is None else {
                 "draft": self.spec.kind,
                 "k": self.spec_k,

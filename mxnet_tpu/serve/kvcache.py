@@ -44,7 +44,10 @@ identical shapes.
 """
 from __future__ import annotations
 
-from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple, Union
+import hashlib
+from collections import OrderedDict
+from typing import (Callable, Dict, List, NamedTuple, Optional, Sequence,
+                    Tuple, Union)
 
 import jax
 import jax.numpy as jnp
@@ -55,10 +58,11 @@ from ..parallel.flash_attention import NEG_INF
 from .. import quant as quantmod
 
 __all__ = ["TRASH_BLOCK", "KV_QUANT_FORMATS", "QuantPool", "BlockAllocator",
-           "make_pools", "is_quantized", "layer_view", "pool_nbytes",
-           "kv_bytes_per_token", "paged_attention", "paged_prefill_attention",
-           "paged_verify_attention", "dense_attention", "write_prefill",
-           "write_decode", "write_spec", "scrub_positions", "compact_pool"]
+           "PrefixIndex", "make_pools", "is_quantized", "layer_view",
+           "pool_nbytes", "kv_bytes_per_token", "paged_attention",
+           "paged_prefill_attention", "paged_verify_attention",
+           "dense_attention", "write_prefill", "write_decode", "write_spec",
+           "scrub_positions", "compact_pool"]
 
 #: physical slot 0 is never handed out: padded prefill positions and
 #: inactive decode rows scatter their garbage there, keeping every
@@ -137,16 +141,38 @@ def kv_bytes_per_token(num_layers: int, heads: int, head_dim: int,
 # ---------------------------------------------------------------------------
 
 class BlockAllocator:
-    """Free-list allocator over the physical slots of a KV pool.
+    """Free-list allocator over the physical slots of a KV pool, with
+    reference counting and an LRU side-cache of refcount-0 blocks.
 
     Slot ``TRASH_BLOCK`` (0) is reserved.  ``alloc`` hands out the
-    lowest free slots (deterministic — replays identically), ``free``
-    returns a request's slots, ``defrag`` compacts live slots toward the
-    low end of the pool and returns the relocation map the engine
-    applies with :func:`compact_pool`.
+    lowest free slots (deterministic — replays identically),
+    ``release`` drops one owner's reference, ``defrag`` compacts live
+    slots toward the low end of the pool and returns the relocation map
+    the engine applies with :func:`compact_pool`.
+
+    A physical slot is in exactly one of three states:
+
+    * **free** — on the free list, contents garbage.
+    * **referenced** — held by one or more owners (``addref`` lets a
+      second request map a slot another request already filled — the
+      prefix cache's copy-on-write sharing; writes only ever target
+      refcount-1 private blocks, so "copy" is structural: a diverging
+      request allocates fresh blocks past the shared prefix).
+    * **cached** — refcount dropped to zero but ``cache_filter`` kept
+      the slot resident (its KV contents are indexed by content hash).
+      Cached slots are *extra capacity, never pressure*: ``alloc``
+      evicts the coldest cached slots (LRU) before failing, and
+      ``num_available``/``can_alloc`` count them as allocatable, so
+      caching never causes an admission reject or preemption that
+      would not have happened anyway.
+
+    ``cache_filter(block) -> bool`` and ``on_evict(block)`` are
+    settable attributes (not ctor args) so the engine can wire the
+    allocator and :class:`PrefixIndex` together after both exist.
     """
 
-    def __init__(self, num_blocks: int, block_size: int):
+    def __init__(self, num_blocks: int, block_size: int,
+                 cache_cap: Optional[int] = None):
         if num_blocks < 2:
             raise MXNetError("BlockAllocator needs >= 2 blocks "
                              "(slot 0 is the reserved trash block)")
@@ -155,7 +181,11 @@ class BlockAllocator:
         self.num_blocks = int(num_blocks)
         self.block_size = int(block_size)
         self._free: List[int] = list(range(1, num_blocks))
-        self._owner: Dict[int, object] = {}   # phys slot -> request id
+        self._refs: Dict[int, set] = {}        # phys slot -> owner set
+        self._cached: "OrderedDict[int, None]" = OrderedDict()  # LRU order
+        self.cache_cap = cache_cap             # max cached slots (None = all)
+        self.cache_filter: Optional[Callable[[int], bool]] = None
+        self.on_evict: Optional[Callable[[int], None]] = None
 
     @property
     def num_free(self) -> int:
@@ -163,67 +193,151 @@ class BlockAllocator:
 
     @property
     def num_used(self) -> int:
-        return len(self._owner)
+        return len(self._refs)
+
+    @property
+    def num_cached(self) -> int:
+        return len(self._cached)
+
+    @property
+    def num_available(self) -> int:
+        """Slots allocatable right now: free plus evictable cached."""
+        return len(self._free) + len(self._cached)
 
     def blocks_for_tokens(self, ntokens: int) -> int:
         """Blocks needed to hold ``ntokens`` cache entries."""
         return max(1, -(-int(ntokens) // self.block_size))
 
     def can_alloc(self, nblocks: int) -> bool:
-        return nblocks <= len(self._free)
+        return nblocks <= self.num_available
+
+    def _evict_one(self) -> None:
+        block, _ = self._cached.popitem(last=False)   # coldest first
+        if self.on_evict is not None:
+            self.on_evict(block)
+        self._free.append(block)
 
     def alloc(self, nblocks: int, owner) -> List[int]:
-        if nblocks > len(self._free):
+        if nblocks > self.num_available:
             raise MXNetError(
                 f"kv pool exhausted: want {nblocks} blocks, "
-                f"{len(self._free)} free of {self.num_blocks - 1}")
+                f"{len(self._free)} free + {len(self._cached)} cached "
+                f"of {self.num_blocks - 1}")
+        while nblocks > len(self._free):
+            self._evict_one()
         self._free.sort()
         got, self._free = self._free[:nblocks], self._free[nblocks:]
         for b in got:
-            self._owner[b] = owner
+            self._refs[b] = {owner}
         return got
 
-    def free(self, blocks: Sequence[int]) -> None:
+    def addref(self, block: int, owner) -> None:
+        """Map an already-resident slot into another owner's table —
+        promotes a cached slot back to referenced, or adds an owner to
+        a shared referenced slot.  Free slots cannot be addref'd."""
+        if block in self._cached:
+            del self._cached[block]
+            self._refs[block] = {owner}
+            return
+        refs = self._refs.get(block)
+        if refs is None:
+            raise MXNetError(f"addref of free kv block {block}")
+        if owner in refs:
+            raise MXNetError(f"owner {owner!r} already references "
+                             f"kv block {block}")
+        refs.add(owner)
+
+    def refcount(self, block: int) -> int:
+        return len(self._refs.get(block, ()))
+
+    def release(self, blocks: Sequence[int], owner) -> None:
+        """Drop ``owner``'s reference on each slot.  A slot whose last
+        reference drops either parks in the LRU cache (``cache_filter``
+        says its contents are worth keeping) or returns to the free
+        list."""
         for b in blocks:
-            if b not in self._owner:
+            refs = self._refs.get(b)
+            if refs is None or owner not in refs:
+                raise MXNetError(
+                    f"release of kv block {b} not held by {owner!r}")
+            refs.discard(owner)
+            if refs:
+                continue
+            del self._refs[b]
+            if self.cache_filter is not None and self.cache_filter(b):
+                self._cached[b] = None          # MRU end
+                if self.cache_cap is not None:
+                    while len(self._cached) > self.cache_cap:
+                        self._evict_one()
+            else:
+                self._free.append(b)
+
+    def uncache(self, blocks: Sequence[int]) -> None:
+        """Return cached slots straight to the free list *without* the
+        ``on_evict`` callback — the invalidation path, where the index
+        has already dropped them.  Unknown slots are ignored."""
+        for b in blocks:
+            if b in self._cached:
+                del self._cached[b]
+                self._free.append(b)
+
+    def free(self, blocks: Sequence[int]) -> None:
+        """Force-drop slots back to the free list regardless of
+        refcount (legacy single-owner path; callers must not share).
+        Cached slots are evicted through ``on_evict`` first."""
+        for b in blocks:
+            if b in self._refs:
+                del self._refs[b]
+                self._free.append(b)
+            elif b in self._cached:
+                del self._cached[b]
+                if self.on_evict is not None:
+                    self.on_evict(b)
+                self._free.append(b)
+            else:
                 raise MXNetError(f"double free of kv block {b}")
-            del self._owner[b]
-            self._free.append(b)
 
     def owned_by(self, owner) -> List[int]:
-        return sorted(b for b, o in self._owner.items() if o == owner)
+        return sorted(b for b, refs in self._refs.items() if owner in refs)
 
     def check(self, tables: Dict[object, Sequence[int]]) -> None:
-        """Table-integrity audit: every table entry is a live slot owned
-        by that request, no slot appears in two tables, and the free
-        list is disjoint from every table."""
-        seen: Dict[int, object] = {}
+        """Table-integrity audit: every table entry is a referenced
+        slot held by that mapper, a slot in several tables is legal iff
+        *each* mapper holds a reference (prefix sharing), cached and
+        free slots appear in no table, and every (slot, owner)
+        reference appears in that owner's table."""
+        seen: Dict[int, List[object]] = {}
         free = set(self._free)
         for owner, table in tables.items():
             for b in table:
                 if b == TRASH_BLOCK:
                     raise MXNetError(f"{owner!r}: table points at the "
                                      "trash block")
-                if self._owner.get(b) != owner:
-                    raise MXNetError(f"{owner!r}: block {b} not owned "
-                                     f"(owner={self._owner.get(b)!r})")
-                if b in seen:
-                    raise MXNetError(f"block {b} shared by {seen[b]!r} "
-                                     f"and {owner!r}")
                 if b in free:
                     raise MXNetError(f"block {b} both free and mapped")
-                seen[b] = owner
-        extra = set(self._owner) - set(seen)
-        if extra:
+                if b in self._cached:
+                    raise MXNetError(f"block {b} both cached (ref-0) "
+                                     f"and mapped by {owner!r}")
+                refs = self._refs.get(b, ())
+                if owner not in refs:
+                    raise MXNetError(f"{owner!r}: block {b} not owned "
+                                     f"(holders={sorted(map(repr, refs))})")
+                seen.setdefault(b, []).append(owner)
+        leaked = sorted(
+            (b, o) for b, refs in self._refs.items() for o in refs
+            if o not in seen.get(b, ()))
+        if leaked:
             raise MXNetError(f"leaked blocks (owned, not in any table): "
-                             f"{sorted(extra)}")
+                             f"{leaked}")
 
     def defrag(self) -> Dict[int, int]:
-        """Compact live slots to the lowest physical indices.  Returns
+        """Compact live slots (referenced *and* cached — cached blocks
+        hold reusable KV) to the lowest physical indices.  Returns
         ``{old_slot: new_slot}`` for every *moved* slot; the caller must
-        rewrite its tables and apply :func:`compact_pool` with the same
-        map before the next device step."""
-        live = sorted(self._owner)
+        rewrite its tables, remap the prefix index, and apply
+        :func:`compact_pool` with the same map before the next device
+        step.  LRU order of cached slots is preserved."""
+        live = sorted(set(self._refs) | set(self._cached))
         mapping: Dict[int, int] = {}
         target = 1
         for b in live:
@@ -231,11 +345,118 @@ class BlockAllocator:
                 mapping[b] = target
             target += 1
         if mapping:
-            self._owner = {mapping.get(b, b): o
-                           for b, o in self._owner.items()}
-            nlive = len(live)
-            self._free = list(range(1 + nlive, self.num_blocks))
+            self._refs = {mapping.get(b, b): o
+                          for b, o in self._refs.items()}
+            self._cached = OrderedDict(
+                (mapping.get(b, b), None) for b in self._cached)
+            self._free = list(range(1 + len(live), self.num_blocks))
         return mapping
+
+
+# ---------------------------------------------------------------------------
+# Host side: content-hashed prefix index
+# ---------------------------------------------------------------------------
+
+class PrefixIndex:
+    """Content hash -> physical slot map for cross-request KV reuse
+    (docs/serving.md §Prefix cache).
+
+    Each *full* block of a token sequence gets a rolling chain hash:
+    ``h_j = blake2b(h_{j-1} | weights_version | tokens_of_block_j)``.
+    Chaining makes the hash position- and prefix-dependent, so equal
+    token windows at different depths never collide, and folding the
+    weights version in means a weight swap invalidates every entry at
+    once (``invalidate`` bumps the version — stale hashes become
+    unreachable even before the map is cleared).
+
+    The index stores only the hash->slot map; residency/refcounts live
+    in :class:`BlockAllocator` (``cache_filter=index.contains_block``
+    keeps indexed blocks resident at refcount 0, ``on_evict=
+    index.drop_block`` unpublishes them when LRU pressure reclaims the
+    slot).  Partial (tail) blocks are never published: only full,
+    prefill-written blocks are content-addressable, which is what makes
+    sharing copy-on-write-safe — every later write lands strictly past
+    the last full prefix block.
+    """
+
+    def __init__(self, block_size: int):
+        if block_size < 1:
+            raise MXNetError(f"block_size must be >= 1, got {block_size}")
+        self.block_size = int(block_size)
+        self.version = 0
+        self._entries: Dict[bytes, int] = {}      # chain hash -> phys slot
+        self._block_hash: Dict[int, bytes] = {}   # phys slot -> chain hash
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def chain_hashes(self, tokens: Sequence[int]) -> List[bytes]:
+        """Rolling chain hash of every *full* block of ``tokens``
+        (``len(tokens) // block_size`` digests; the partial tail is
+        never hashed)."""
+        bs = self.block_size
+        ver = self.version.to_bytes(8, "little")
+        out: List[bytes] = []
+        prev = b"\x00" * 16
+        for j in range(len(tokens) // bs):
+            blk = np.asarray(tokens[j * bs:(j + 1) * bs], np.int64).tobytes()
+            prev = hashlib.blake2b(prev + ver + blk,
+                                   digest_size=16).digest()
+            out.append(prev)
+        return out
+
+    def match(self, tokens: Sequence[int]) -> List[int]:
+        """Longest indexed prefix: physical slots for the leading run
+        of full blocks whose chain hashes are all present (stops at the
+        first miss — the chain guarantees no gaps)."""
+        blocks: List[int] = []
+        for h in self.chain_hashes(tokens):
+            b = self._entries.get(h)
+            if b is None:
+                break
+            blocks.append(b)
+        return blocks
+
+    def publish(self, h: bytes, block: int) -> bool:
+        """Register ``block`` as the canonical holder of chain hash
+        ``h``.  First publisher wins: a duplicate hash (another request
+        prefilled the same prefix in the same step) leaves the existing
+        entry — the late block simply stays private and unshared.
+        Returns whether the entry was inserted."""
+        if h in self._entries or block in self._block_hash:
+            return False
+        self._entries[h] = block
+        self._block_hash[block] = h
+        return True
+
+    def contains_block(self, block: int) -> bool:
+        return block in self._block_hash
+
+    def drop_block(self, block: int) -> None:
+        """Unpublish one slot (LRU eviction / force-free).  Safe no-op
+        for unindexed slots."""
+        h = self._block_hash.pop(block, None)
+        if h is not None:
+            self._entries.pop(h, None)
+
+    def invalidate(self) -> List[int]:
+        """Drop every entry and bump the weights version (weight swap:
+        resident KV no longer matches the model).  Returns the slots
+        that were indexed so the caller can ``uncache`` them."""
+        dropped = sorted(self._block_hash)
+        self.version += 1
+        self._entries.clear()
+        self._block_hash.clear()
+        return dropped
+
+    def remap(self, mapping: Dict[int, int]) -> None:
+        """Apply a :meth:`BlockAllocator.defrag` relocation map."""
+        if not mapping:
+            return
+        self._entries = {h: mapping.get(b, b)
+                         for h, b in self._entries.items()}
+        self._block_hash = {mapping.get(b, b): h
+                            for b, h in self._block_hash.items()}
 
 
 # ---------------------------------------------------------------------------

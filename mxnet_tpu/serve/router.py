@@ -121,8 +121,11 @@ class Replica:
         return self.engine.sched.active + self.engine.sched.queue_depth
 
     def kv_frac(self) -> float:
+        # cached (refcount-0 prefix) blocks count as available: the
+        # allocator evicts them on demand, so they are capacity, not
+        # occupancy — a cache-full replica must not shed
         used = self.engine.alloc.num_used
-        total = used + self.engine.alloc.num_free
+        total = used + self.engine.alloc.num_available
         return used / max(1, total)
 
 
@@ -232,7 +235,7 @@ class Router:
                 slo_ms=slo_ms, eos_id=eos_id, deadline_ms=deadline_ms,
                 seed=(int(seed) if seed is not None else rid),
                 submit_t=self._clock())
-            target = self._pick()
+            target = self._pick(rr.prompt)
             reason = self._shed_reason(rr, target)
             if reason is not None:
                 rr.state = FAILED
@@ -415,7 +418,7 @@ class Router:
             rr.state = FINISHED
             rr.finish_reason = "length"
             return
-        target = self._pick()
+        target = self._pick(rr.prompt + rr.tokens)
         if target is None or rr.failovers > self.config.max_failovers:
             self._fail(rr, "error")
             return
@@ -464,7 +467,7 @@ class Router:
                 rep.engine.sched.cancel(ereq)
                 rr.replica = None
                 rr.engine_rid = None
-                target = self._pick()
+                target = self._pick(rr.prompt + rr.tokens)
                 if target is None:
                     self._fail(rr, "error")
                     continue
@@ -621,10 +624,16 @@ class Router:
 
     # -- placement & shedding ----------------------------------------------
 
-    def _pick(self) -> Optional[Replica]:
-        """Least-loaded healthy replica with queue room (ties: lowest
-        index — deterministic placement, pinned by the failover parity
-        tests)."""
+    def _pick(self, tokens: Optional[Sequence[int]] = None
+              ) -> Optional[Replica]:
+        """Placement: prefix-affinity first, then least-loaded (ties:
+        lowest index — deterministic placement, pinned by the failover
+        parity tests).  When ``tokens`` is given and replicas run the
+        prefix cache, the replica whose cache holds the LONGEST
+        matching prefix of them wins regardless of load — re-prefilling
+        a long prefix elsewhere costs more than queueing behind the
+        warm replica; with no cache (or no hit anywhere) the key
+        degrades to the classic least-loaded rule."""
         best = None
         for rep in self.replicas:
             if rep.state != HEALTHY:
@@ -632,7 +641,8 @@ class Router:
             eng = rep.engine
             if eng.sched.queue_depth >= eng.config.max_queue:
                 continue
-            key = (rep.load, rep.idx)
+            hit = eng.prefix_probe(tokens) if tokens is not None else 0
+            key = (-hit, rep.load, rep.idx)
             if best is None or key < best[0]:
                 best = (key, rep)
         return None if best is None else best[1]

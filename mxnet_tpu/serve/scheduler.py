@@ -76,6 +76,13 @@ class Request:
     # once; a preempted request resets both and re-chunks on re-admit.
     prefilled: int = 0
     prefill_target: int = 0
+    # prefix-cache state (engine-managed): blocks pinned from the
+    # prefix index at admission (consumed by _prefill_begin), tokens
+    # satisfied from cache this prefill, and how many leading full
+    # blocks of this request have been published to the index.
+    prefix_blocks: List[int] = field(default_factory=list)
+    prefix_hit: int = 0
+    published: int = 0
     # speculative decode (engine-managed): drafts in play for this
     # row's next verify step (0 = plain decode shape)
     spec_live: int = 0

@@ -1,0 +1,558 @@
+"""Cross-request prefix KV cache (round 18, docs/serving.md §Prefix
+cache): content-hashed block reuse, copy-on-write sharing, LRU
+eviction, prefix-affinity routing.
+
+The contracts under test, per issue 19's acceptance criteria:
+
+* allocator refcount/addref/release matrix, LRU cache + cap eviction,
+  ``check()`` table integrity under sharing, force-free of cached slots;
+* ``PrefixIndex``: rolling chain hashes (position- and
+  prefix-sensitive, partial tails never hashed), longest-prefix match,
+  first-publisher-wins dedupe, version invalidation, defrag remap;
+* warm (cache-hit) streams are BYTE-IDENTICAL to a cache-cold run —
+  greedy AND seeded sampling, f32 AND fp8 pools, plain AND speculative
+  decode — with zero post-warmup retraces;
+* NaN poison with two requests sharing a prefix scrubs only private
+  blocks: the shared/indexed blocks survive clean and a later request
+  reuses them byte-exactly;
+* weight swaps invalidate the index (target) or leave it alone
+  (draft); preemption and router failover re-probe on re-prefill and
+  stay byte-identical; defrag relocates cached blocks correctly.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import telemetry
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.chaos import ChaosSpec
+from mxnet_tpu.models.transformer import transformer_lm
+from mxnet_tpu.serve import (Engine, EngineConfig, Router, RouterConfig,
+                             ServeError)
+from mxnet_tpu.serve.kvcache import BlockAllocator, PrefixIndex, TRASH_BLOCK
+
+V, NL, D, H = 61, 2, 32, 4
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    telemetry.reset_for_tests()
+    yield
+    telemetry.reset_for_tests()
+
+
+def _make_params(seed=0):
+    rng = np.random.RandomState(seed)
+    sym = transformer_lm(vocab_size=V, num_layers=NL, d_model=D, heads=H,
+                         batch_size=1, seq_len=8)
+    shapes, _, _ = sym.infer_shape(data=(1, 8), softmax_label=(1, 8))
+    return {n: (rng.randn(*s) * 0.05).astype(np.float32)
+            for n, s in zip(sym.list_arguments(), shapes)
+            if n not in ("data", "softmax_label")}
+
+
+_PARAMS = _make_params()
+_PARAMS2 = _make_params(seed=3)
+
+_ECFG = dict(heads=H, block_size=4, num_blocks=64, max_batch=4,
+             max_prompt_len=16, max_seq_len=48, prompt_bucket_min=8,
+             prefill_chunk=4)
+
+
+def _engine(prefix_cache=True, **over):
+    cfg = dict(_ECFG)
+    cfg.update(over)
+    return Engine(_PARAMS, EngineConfig(prefix_cache=prefix_cache, **cfg))
+
+
+# a 12-token system prompt (3 full blocks at block_size=4) shared by
+# every stream, plus distinct per-stream suffixes; mixed greedy/seeded
+_PREFIX = [7, 3, 11, 19, 2, 40, 5, 8, 23, 17, 31, 4]
+_SUFFIXES = [[50, 51], [52, 53, 54], [55], [56, 57], [58, 59, 60]]
+_KW = [dict(max_new_tokens=8, temperature=(0.8 if i % 2 else 0.0),
+            top_k=(5 if i % 2 else 0), seed=900 + i)
+       for i in range(len(_SUFFIXES))]
+
+
+def _cold_streams(**over):
+    """Per-request cache-off reference: each prompt alone on a fresh
+    no-cache engine — the byte-identity yardstick."""
+    outs = []
+    for sfx, kw in zip(_SUFFIXES, _KW):
+        e = _engine(prefix_cache=False, **over)
+        outs.append(e.result(e.submit(_PREFIX + sfx, **kw)))
+    return outs
+
+
+# ---------------------------------------------------------------------------
+# Allocator: refcount / addref / release matrix
+# ---------------------------------------------------------------------------
+
+def test_allocator_refcount_matrix():
+    al = BlockAllocator(num_blocks=16, block_size=4)
+    a = al.alloc(2, "a")
+    assert al.refcount(a[0]) == 1
+    al.addref(a[0], "b")                        # share block a[0]
+    assert al.refcount(a[0]) == 2
+    assert al.owned_by("b") == [a[0]]
+    with pytest.raises(MXNetError):
+        al.addref(a[0], "b")                    # duplicate owner
+    with pytest.raises(MXNetError):
+        al.addref(15, "c")                      # free slot
+    al.release(a, "a")                          # a drops both
+    assert al.refcount(a[0]) == 1               # b still holds it
+    assert al.refcount(a[1]) == 0               # last ref -> free
+    assert a[1] not in al.owned_by("a")
+    with pytest.raises(MXNetError):
+        al.release([a[1]], "a")                 # double release
+    with pytest.raises(MXNetError):
+        al.release([a[0]], "z")                 # never held
+    al.release([a[0]], "b")
+    assert al.num_used == 0 and al.num_free == 15
+
+
+def test_allocator_lru_cache_and_cap_eviction():
+    al = BlockAllocator(num_blocks=8, block_size=4)
+    evicted = []
+    al.cache_filter = lambda b: True
+    al.on_evict = evicted.append
+    a = al.alloc(3, "a")
+    al.release(a, "a")
+    assert al.num_cached == 3 and al.num_used == 0
+    assert al.num_free == 7 - 3
+    assert al.num_available == 7                # cached = extra capacity
+    assert al.can_alloc(7)
+    # allocation evicts coldest-first (release order = LRU order)
+    got = al.alloc(6, "x")
+    assert evicted == a[:2]                     # two evictions sufficed
+    assert al.num_cached == 1
+    al.release(got, "x")                        # everything re-parks
+    # addref promotes a cached slot back to referenced
+    al.addref(a[2], "y")
+    assert al.refcount(a[2]) == 1 and al.num_cached == 6
+    al.release([a[2]], "y")
+    # cache_cap bounds the parked set
+    al2 = BlockAllocator(num_blocks=8, block_size=4, cache_cap=2)
+    ev2 = []
+    al2.cache_filter = lambda b: True
+    al2.on_evict = ev2.append
+    b = al2.alloc(4, "b")
+    al2.release(b, "b")
+    assert al2.num_cached == 2 and ev2 == b[:2]
+
+
+def test_allocator_check_under_sharing():
+    al = BlockAllocator(num_blocks=16, block_size=4)
+    a = al.alloc(3, "a")
+    fresh = al.alloc(1, "b")
+    al.addref(a[0], "b")
+    al.addref(a[1], "b")
+    shared_tables = {"a": a, "b": [a[0], a[1]] + fresh}
+    al.check(shared_tables)                     # sharing with refs: legal
+    with pytest.raises(MXNetError, match="not owned"):
+        al.check({"a": a, "b": [a[2]] + fresh})  # maps block w/o a ref
+    with pytest.raises(MXNetError, match="leaked"):
+        al.check({"a": a, "b": fresh})          # b's shares unaccounted
+    with pytest.raises(MXNetError, match="trash"):
+        al.check({"a": [TRASH_BLOCK] + a[1:], "b": [a[0], a[1]] + fresh})
+    # a cached (ref-0) slot must not appear in any table
+    al.cache_filter = lambda blk: True
+    al.release([a[2]], "a")
+    with pytest.raises(MXNetError, match="cached"):
+        al.check({"a": a, "b": [a[0], a[1]] + fresh})
+    al.check({"a": a[:2], "b": [a[0], a[1]] + fresh})
+
+
+def test_allocator_force_free_and_defrag_cached():
+    al = BlockAllocator(num_blocks=10, block_size=4)
+    dropped = []
+    al.cache_filter = lambda b: True
+    al.on_evict = dropped.append
+    a = al.alloc(2, "a")
+    b = al.alloc(2, "b")
+    al.release(a, "a")                          # a -> cached
+    al.free([a[0]])                             # force-drop a cached slot
+    assert dropped == [a[0]]
+    with pytest.raises(MXNetError, match="double free"):
+        al.free([a[0]])
+    # defrag relocates referenced AND cached slots; b=[3,4] -> [1,2],
+    # cached a[1]=2 -> 3
+    mapping = al.defrag()
+    assert al.owned_by("b") == [mapping.get(x, x) for x in b]
+    assert al.num_cached == 1
+    assert al.num_free == 9 - 3
+
+
+# ---------------------------------------------------------------------------
+# PrefixIndex: chain hashes, match, dedupe, invalidation
+# ---------------------------------------------------------------------------
+
+def test_prefix_index_chain_hashes_position_sensitive():
+    idx = PrefixIndex(block_size=4)
+    toks = [1, 2, 3, 4, 5, 6, 7, 8]
+    h = idx.chain_hashes(toks)
+    assert len(h) == 2
+    # partial tails are never hashed
+    assert len(idx.chain_hashes(toks[:7])) == 1
+    assert idx.chain_hashes(toks[:4]) == h[:1]
+    # same second-block tokens behind a DIFFERENT first block: the
+    # chain makes the second digest differ (position/prefix sensitivity)
+    h2 = idx.chain_hashes([9, 9, 9, 9, 5, 6, 7, 8])
+    assert h2[0] != h[0] and h2[1] != h[1]
+    # version is folded into every digest
+    idx.version += 1
+    assert idx.chain_hashes(toks) != h
+
+
+def test_prefix_index_match_publish_drop_remap():
+    idx = PrefixIndex(block_size=4)
+    toks = [1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12]
+    h = idx.chain_hashes(toks)
+    assert idx.match(toks) == []
+    assert idx.publish(h[0], 5) and idx.publish(h[1], 9)
+    assert idx.match(toks) == [5, 9]            # longest prefix, in order
+    assert idx.match(toks[:6]) == [5]
+    assert idx.match([2] + toks[1:]) == []
+    # a gap stops the walk: block 2 published without block 1 resident
+    assert idx.publish(h[2], 11)
+    idx.drop_block(9)
+    assert idx.match(toks) == [5]
+    idx.drop_block(9)                           # double drop: no-op
+    # first publisher wins; one slot holds one hash
+    assert not idx.publish(h[0], 7)
+    assert not idx.publish(h[1], 5)
+    assert idx.contains_block(5) and not idx.contains_block(9)
+    idx.remap({5: 2, 11: 3})
+    assert idx.match(toks[:4]) == [2]
+    dropped = idx.invalidate()
+    assert dropped == [2, 3]
+    assert len(idx) == 0 and idx.version == 1
+    assert idx.match(toks) == []
+
+
+# ---------------------------------------------------------------------------
+# Engine: warm streams byte-identical to cache-cold, zero retraces
+# ---------------------------------------------------------------------------
+
+def test_warm_streams_byte_identical_greedy_and_seeded():
+    ref = _cold_streams()
+    eng = _engine()
+    eng.warmup()
+    # serial: each request fully completes before the next submits, so
+    # streams 2..N hit the prefix published by stream 1
+    outs = [eng.result(eng.submit(_PREFIX + sfx, **kw))
+            for sfx, kw in zip(_SUFFIXES, _KW)]
+    assert outs == ref
+    st = eng.stats()["prefix"]
+    assert st["hits"] == len(_SUFFIXES) - 1
+    assert st["misses"] == 1
+    assert st["hit_tokens"] == (len(_SUFFIXES) - 1) * 12
+    assert eng.alloc.num_used == 0 and eng.alloc.num_cached > 0
+    eng.check_tables()
+    flat = telemetry.snapshot_flat()
+    assert flat.get("serve.prefix.hits") == len(_SUFFIXES) - 1
+    assert flat.get("serve.prefix.hit_tokens") == (len(_SUFFIXES) - 1) * 12
+    assert flat.get("serve.prefix.shared_blocks") == (len(_SUFFIXES) - 1) * 3
+
+
+def test_warm_cohort_one_prefill_of_the_prefix():
+    """8 same-step streams over one system prompt: the second-chance
+    re-probe makes streams 2..8 map what stream 1 just published."""
+    ref = _cold_streams()
+    base = telemetry.snapshot_flat().get("serve.prefill_chunks", 0)
+    eng = _engine(max_batch=8)
+    eng.warmup()
+    ids = [eng.submit(_PREFIX + sfx, **kw)
+           for sfx, kw in zip(_SUFFIXES, _KW)]
+    eng.run()
+    assert [eng.requests[i].tokens for i in ids] == ref
+    st = eng.stats()["prefix"]
+    assert st["hits"] == len(_SUFFIXES) - 1 and st["misses"] == 1
+    # the prefix's chunks ran exactly once: the miss stream's 4 chunks
+    # cover prefix + its suffix; every other stream ran ONE suffix chunk
+    flat = telemetry.snapshot_flat()
+    assert flat.get("serve.prefill_chunks") - base == 3 + len(_SUFFIXES)
+
+
+def test_zero_retraces_and_cached_ttft_one_chunk():
+    eng = _engine()
+    eng.warmup()
+    eng.result(eng.submit(_PREFIX + _SUFFIXES[0], **_KW[0]))
+    snap = dict(eng.trace_counts)
+    flat0 = telemetry.snapshot_flat()
+    chunks0 = flat0.get("serve.prefill_chunks")
+    rid = eng.submit(_PREFIX + _SUFFIXES[1], **_KW[1])
+    eng.run()
+    assert dict(eng.trace_counts) == snap       # zero post-warmup traces
+    # cached TTFT: the warm prefill ran ONE chunk (the suffix), not 4
+    flat1 = telemetry.snapshot_flat()
+    assert flat1.get("serve.prefill_chunks") - chunks0 == 1
+    assert eng.requests[rid].prefix_hit == 12
+
+
+def test_fp8_shared_scale_parity():
+    ref = _cold_streams(kv_quant="fp8")
+    eng = _engine(kv_quant="fp8")
+    eng.warmup()
+    outs = [eng.result(eng.submit(_PREFIX + sfx, **kw))
+            for sfx, kw in zip(_SUFFIXES, _KW)]
+    assert outs == ref
+    assert eng.stats()["prefix"]["hits"] == len(_SUFFIXES) - 1
+
+
+def test_exact_resubmit_hits_floored_below_prompt_len():
+    """A prompt whose EVERY block is cached still runs one real chunk:
+    the hit is capped strictly below the prompt length (the final
+    chunk samples the first token), floored to the chunk grid."""
+    e0 = _engine(prefix_cache=False)
+    want = e0.result(e0.submit(_PREFIX, max_new_tokens=6))
+    eng = _engine()
+    eng.result(eng.submit(_PREFIX, max_new_tokens=6))
+    rid = eng.submit(_PREFIX, max_new_tokens=6)
+    assert eng.result(rid) == want
+    # 3 blocks resident, but hit = floor(min(12, 11) / 4) = 2 blocks
+    assert eng.requests[rid].prefix_hit == 8
+
+
+def test_short_prefix_below_min_blocks_not_mapped():
+    eng = _engine(prefix_min_blocks=2)
+    eng.warmup()
+    eng.result(eng.submit([5, 6, 7, 8, 9], max_new_tokens=4))
+    rid = eng.submit([5, 6, 7, 8, 9, 1], max_new_tokens=4)
+    eng.result(rid)
+    # only one full block matches -> below min_blocks -> no mapping
+    assert eng.requests[rid].prefix_hit == 0
+    assert eng.stats()["prefix"]["hits"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Sharing-safe NaN scrub (satellite: poison over a shared prefix)
+# ---------------------------------------------------------------------------
+
+def test_two_request_shared_prefix_poison_spares_shared_blocks():
+    clean = _engine()
+    want = clean.result(clean.submit(_PREFIX + _SUFFIXES[2], **_KW[2]))
+
+    cfg = dict(_ECFG)
+    eng = Engine(_PARAMS, EngineConfig(prefix_cache=True, **cfg),
+                 chaos=ChaosSpec({"serve_poison_logits": {4}}))
+    eng.warmup()
+    a = eng.submit(_PREFIX + _SUFFIXES[0], max_new_tokens=8, seed=1)
+    b = eng.submit(_PREFIX + _SUFFIXES[1], max_new_tokens=8, seed=2)
+    for rid in (a, b):
+        with pytest.raises(ServeError) as exc:
+            eng.result(rid)
+        assert exc.value.reason == "error"
+    # both died sharing the prefix blocks; the shared (indexed) blocks
+    # were NOT zeroed — request C maps them and decodes byte-exactly
+    assert eng.alloc.num_used == 0
+    assert eng.alloc.num_cached >= 3
+    rid_c = eng.submit(_PREFIX + _SUFFIXES[2], **_KW[2])
+    assert eng.result(rid_c) == want
+    assert eng.requests[rid_c].prefix_hit == 12
+    eng.check_tables()
+
+
+# ---------------------------------------------------------------------------
+# Composition: speculation, weight swaps, preemption, defrag
+# ---------------------------------------------------------------------------
+
+def test_speculation_composes_with_prefix_cache():
+    def run(prefix_cache):
+        outs = []
+        for sfx, kw in zip(_SUFFIXES[:3], _KW[:3]):
+            e = _engine(prefix_cache=False, speculate=True, spec_k=2)
+            outs.append(e.result(e.submit(_PREFIX + sfx, **kw)))
+        return outs
+
+    ref = run(False)
+    eng = _engine(speculate=True, spec_k=2)
+    eng.warmup()
+    outs = [eng.result(eng.submit(_PREFIX + sfx, **kw))
+            for sfx, kw in zip(_SUFFIXES[:3], _KW[:3])]
+    assert outs == ref
+    assert eng.stats()["prefix"]["hits"] == 2
+    assert eng.alloc.num_used == 0
+    eng.check_tables()
+
+
+def test_target_swap_invalidates_index():
+    eng = _engine()
+    eng.warmup()
+    eng.result(eng.submit(_PREFIX + _SUFFIXES[0], **_KW[0]))
+    assert len(eng.prefix) > 0 and eng.alloc.num_cached > 0
+    eng.swap_weights(_PARAMS2)
+    assert len(eng.prefix) == 0
+    assert eng.prefix.version == 1
+    assert eng.alloc.num_cached == 0            # cached slots uncached
+    # post-swap: same prompt is a MISS and matches a fresh new-weights
+    # engine byte-for-byte (no stale-KV reuse)
+    fresh = Engine(_PARAMS2, EngineConfig(**_ECFG))
+    want = fresh.result(fresh.submit(_PREFIX + _SUFFIXES[1], **_KW[1]))
+    rid = eng.submit(_PREFIX + _SUFFIXES[1], **_KW[1])
+    assert eng.result(rid) == want
+    assert eng.requests[rid].prefix_hit == 0
+    assert eng.stats()["prefix"]["misses"] == 2
+
+
+def test_draft_swap_does_not_invalidate_index():
+    draft = _make_params(seed=7)
+    cfg = dict(_ECFG)
+    eng = Engine(_PARAMS,
+                 EngineConfig(prefix_cache=True, speculate=True, spec_k=2,
+                              spec_draft="model", **cfg),
+                 draft_params=draft, draft_heads=H)
+    eng.warmup()
+    eng.result(eng.submit(_PREFIX + _SUFFIXES[0], **_KW[0]))
+    entries = len(eng.prefix)
+    assert entries > 0
+    eng.swap_draft_weights(_make_params(seed=9))
+    # the draft model never writes target KV: index untouched
+    assert len(eng.prefix) == entries and eng.prefix.version == 0
+    rid = eng.submit(_PREFIX + _SUFFIXES[1], **_KW[1])
+    eng.result(rid)
+    assert eng.requests[rid].prefix_hit == 12
+
+
+def test_preemption_reprobes_and_stays_byte_identical():
+    """Pool pressure path: a tiny pool forces preemption; the victim's
+    re-prefill re-probes the index (its own published blocks parked in
+    the cache), and every stream still matches the cache-off run."""
+    kw = dict(num_blocks=14, max_batch=3)
+    refs = []
+    for sfx, k in zip(_SUFFIXES[:3], _KW[:3]):
+        e = _engine(prefix_cache=False, **kw)
+        refs.append(e.result(e.submit(_PREFIX + sfx, **k)))
+    eng = _engine(**kw)
+    eng.warmup()
+    ids = [eng.submit(_PREFIX + sfx, **k)
+           for sfx, k in zip(_SUFFIXES[:3], _KW[:3])]
+    eng.run()
+    assert [eng.requests[i].tokens for i in ids] == refs
+    assert eng.alloc.num_used == 0
+    eng.check_tables()
+
+
+def test_defrag_under_sharing_bitwise_stable():
+    ref = _cold_streams()
+    eng = _engine(max_batch=8)
+    eng.warmup()
+    ids = [eng.submit(_PREFIX + sfx, **kw)
+           for sfx, kw in zip(_SUFFIXES, _KW)]
+    for _ in range(120):
+        if eng.sched.idle():
+            break
+        eng.step()
+        eng.defrag()                            # defrag EVERY step
+        eng.check_tables()
+    assert [eng.requests[i].tokens for i in ids] == ref
+    # cached blocks survived relocation: a follow-up still hits
+    rid = eng.submit(_PREFIX + [42], max_new_tokens=4)
+    eng.result(rid)
+    assert eng.requests[rid].prefix_hit == 12
+
+
+def test_lru_eviction_under_tight_cap():
+    eng = _engine(prefix_cap_frac=0.08)         # cap = 5 of 63 blocks
+    eng.warmup()
+    rng = np.random.RandomState(11)
+    for i in range(6):                          # 6 distinct 12-token prefixes
+        p = list(map(int, rng.randint(1, V, 12)))
+        eng.result(eng.submit(p + [int(rng.randint(1, V))],
+                              max_new_tokens=4))
+    assert eng.alloc.num_cached <= 5
+    assert eng.stats()["prefix"]["evictions"] > 0
+    assert eng.alloc.num_used == 0
+    eng.check_tables()
+
+
+# ---------------------------------------------------------------------------
+# Router: prefix-affinity dispatch + warm failover
+# ---------------------------------------------------------------------------
+
+def test_router_prefix_affinity_dispatch():
+    ecfg = EngineConfig(prefix_cache=True, **_ECFG)
+    router = Router(_PARAMS, ecfg, RouterConfig(replicas=2))
+    router.warmup()
+    r0 = router.submit(_PREFIX + _SUFFIXES[0], **_KW[0])
+    router.run()
+    first = router.request(r0).replica.idx
+    # the warm replica now wins dispatch for prefix-sharing prompts
+    # even though round-robin-by-load would alternate
+    for sfx, kw in zip(_SUFFIXES[1:3], _KW[1:3]):
+        rid = router.submit(_PREFIX + sfx, **kw)
+        assert router.request(rid).replica.idx == first
+        router.run()
+    # an unrelated prompt falls back to least-loaded (no hit anywhere)
+    rid = router.submit([44, 45, 46], max_new_tokens=4)
+    assert router.request(rid).replica is not None
+    router.run()
+    warm = router.replicas[first].engine.stats()["prefix"]
+    assert warm["hits"] == 2
+
+
+def test_failover_with_warm_destination_byte_identical():
+    """Mid-stream failover onto a replica whose cache already holds
+    the prefix: the adopted continuation re-probes the index on the
+    destination and the merged client stream stays byte-identical to a
+    no-failure, no-cache run."""
+    prompts = [_PREFIX + s for s in _SUFFIXES[:4]]
+    kws = _KW[:4]
+    refs = []
+    for p, k in zip(prompts, kws):
+        e = _engine(prefix_cache=False)
+        refs.append(e.result(e.submit(p, **k)))
+
+    ecfg = EngineConfig(prefix_cache=True, **_ECFG)
+    # crash replica 0 at its step 5: the pre-warm request below runs
+    # entirely in step 1 (the pump drains every chunk), so step 5 lands
+    # mid-decode of the router-submitted streams
+    router = Router(_PARAMS, ecfg, RouterConfig(replicas=2),
+                    chaos={0: ChaosSpec({"serve_crash": {5}})})
+    router.warmup()
+    # pre-warm BOTH caches directly: affinity then ties on the prefix
+    # and load spreads the streams, so the crash kills live streams
+    # whose failover destination is already warm
+    for rep in router.replicas:
+        rep.engine.result(rep.engine.submit(_PREFIX + [49],
+                                            max_new_tokens=2))
+    ids = [router.submit(p, **k) for p, k in zip(prompts, kws)]
+    router.run()
+    assert [router.request(i).state for i in ids] == ["finished"] * 4
+    assert [router.request(i).tokens for i in ids] == refs
+    dead, surv = router.replicas
+    assert dead.state == "dead" and surv.state == "healthy"
+    # the survivor served adopted continuations from its warm cache
+    assert surv.engine.stats()["prefix"]["hits"] >= 1
+    assert surv.engine.alloc.num_used == 0
+    surv.engine.check_tables()
+    flat = telemetry.snapshot_flat()
+    assert flat.get("serve.router.failovers", 0) >= 1
+
+
+# ---------------------------------------------------------------------------
+# Config validation + env knobs
+# ---------------------------------------------------------------------------
+
+def test_prefix_config_validation():
+    cfg = dict(_ECFG)
+    cfg.pop("prefill_chunk")
+    with pytest.raises(MXNetError, match="chunked prefill"):
+        Engine(_PARAMS, EngineConfig(prefix_cache=True, prefill_chunk=0,
+                                     **cfg))
+    with pytest.raises(MXNetError, match="prefix_cap_frac"):
+        _engine(prefix_cap_frac=0.0)
+    with pytest.raises(MXNetError, match="prefix_min_blocks"):
+        _engine(prefix_min_blocks=0)
+
+
+def test_prefix_env_knobs(monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_SERVE_PREFIX_CACHE", "1")
+    monkeypatch.setenv("MXNET_TPU_SERVE_PREFIX_CAP_FRAC", "0.25")
+    monkeypatch.setenv("MXNET_TPU_SERVE_PREFIX_MIN_BLOCKS", "3")
+    cfg = EngineConfig.from_env()
+    assert cfg.prefix_cache is True
+    assert cfg.prefix_cap_frac == 0.25
+    assert cfg.prefix_min_blocks == 3
+    monkeypatch.setenv("MXNET_TPU_SERVE_PREFIX_CACHE", "0")
+    assert EngineConfig.from_env().prefix_cache is False

@@ -312,6 +312,10 @@ SWAP_MS_SLACK = 25.0
 # host load: a real drop means the drafter (or the acceptance rule)
 # changed behavior.  Gate absolute drops beyond this, not noise.
 SPEC_ACCEPT_DROP = 0.10
+# prefix-cache hit rate is likewise workload-determined (the bench
+# replays a fixed shared-prefix trace): a drop means probe/publish
+# behavior changed, not that the host was busy
+PREFIX_HIT_DROP = 0.10
 
 
 def diff_serve(path_a, path_b):
@@ -340,7 +344,16 @@ def diff_serve(path_a, path_b):
     own >= 2x pass, greedy streams must stay byte-identical to the
     non-speculative engine, zero post-warmup retraces, acceptance rate
     may not drop more than ``SPEC_ACCEPT_DROP`` absolute, and the
-    speedup ratio gets the ``SERVE_TOKENS_TOL`` noise floor."""
+    speedup ratio gets the ``SERVE_TOKENS_TOL`` noise floor.
+
+    Prefix rows (``bench.py --serve --prefix``, BENCH_r16) gate the
+    round-18 contract: the gated shared-prefix row must keep its own
+    pass (cached TTFT and tokens/s bars), warm streams must stay
+    byte-identical to the cache-cold engine with zero post-warmup
+    retraces, cached TTFT may not grow past ``SERVE_TTFT_GROWTH``
+    (beyond the absolute slack), and the hit rate — a
+    workload-determined property — may not fall more than
+    ``PREFIX_HIT_DROP`` absolute between reports."""
     a, b = read_serve(path_a), read_serve(path_b)
     common = [m for m in a if m in b]
     if not common:
@@ -439,6 +452,33 @@ def diff_serve(path_a, path_b):
                 and (sb - sa) / sa < -SERVE_TOKENS_TOL:
             worse.append(f"{metric}: speculative speedup fell "
                          f"{sa:g}x -> {sb:g}x")
+    for metric, rec in b.items():
+        if "prefix" not in metric:
+            continue
+        # the BENCH_r16 contract (docs/serving.md §Cross-request
+        # prefix cache): warm streams byte-identical to cache-cold,
+        # zero retraces, cached TTFT bounded, hit rate stable
+        if rec.get("pass") is False:
+            worse.append(f"{metric}: prefix-cache row failed its own "
+                         "gate in report B")
+        if rec.get("streams_identical") is False:
+            worse.append(f"{metric}: warm streams diverged from the "
+                         "cache-cold engine (byte-identity broken)")
+        if rec.get("new_traces", 0) != 0:
+            worse.append(f"{metric}: prefix-cache scenario retraced "
+                         f"{rec.get('new_traces')} programs post-warmup")
+        ra = a.get(metric, {})
+        ca, cb = ra.get("cached_ttft_ms"), rec.get("cached_ttft_ms")
+        if ca and cb is not None:
+            pct = (cb - ca) / ca
+            if pct > SERVE_TTFT_GROWTH and cb - ca > SERVE_LAT_SLACK_MS:
+                worse.append(f"{metric}: cached TTFT grew "
+                             f"{100 * pct:.0f}% ({ca:g} -> {cb:g} ms)")
+        ha, hb = ra.get("hit_rate"), rec.get("hit_rate")
+        if ha is not None and hb is not None \
+                and ha - hb > PREFIX_HIT_DROP:
+            worse.append(f"{metric}: prefix hit rate fell {ha:g} -> "
+                         f"{hb:g} (> {PREFIX_HIT_DROP:g} absolute)")
     for msg in worse:
         print(f"REGRESSED: {msg}", file=sys.stderr)
     return 1 if worse else 0

@@ -32,7 +32,14 @@ and asserts:
    step 1, finishes all 8 streams with greedy rows byte-identical to
    the plain engine, advances ``serve.spec.steps`` /
    ``serve.spec.accepted``, and drains the pool to zero used blocks
-   (the rejected-tail scrub keeps the block ledger exact).
+   (the rejected-tail scrub keeps the block ledger exact);
+7. the round-18 prefix cache reuses a shared system prompt across a
+   same-step cohort: 8 streams over one 12-token prefix on a
+   ``prefix_cache=True`` fp8 engine prefill the prefix EXACTLY once
+   (7 second-chance hits, 1 miss), stay byte-identical to a cache-off
+   engine, stay warm after step 1, advance the ``serve.prefix.*``
+   counters, and drain with zero used blocks (the cached prefix
+   blocks park refcount-0, not leaked).
 
 Exit 0 on success, 1 with a reason on any failure.  Runs on the CPU
 mesh in a few seconds; invoked by tools/ci_check.sh after the
@@ -299,6 +306,74 @@ def main() -> None:
              "nothing on cycling greedy streams)")
     spec_stats = spec_eng.stats()["speculate"]
 
+    # --- 7. cross-request prefix cache (docs/serving.md, round 18) --
+    # 8 same-step streams over one shared 12-token system prompt: the
+    # first stream prefills it, the other 7 map its published blocks
+    # via the second-chance re-probe — one prefill of the prefix,
+    # byte-identical streams, no retraces, no leak.
+    pfx_cfg = dict(heads=H, block_size=4, num_blocks=64, max_batch=8,
+                   max_prompt_len=16, max_seq_len=48,
+                   prompt_bucket_min=8, prefill_chunk=4, kv_quant="fp8")
+    shared = [int(t) for t in np.random.RandomState(3).randint(1, V, 12)]
+    sfx_rng = np.random.RandomState(5)
+    pfx_prompts = [shared + [int(t) for t in
+                             sfx_rng.randint(1, V, int(sfx_rng.randint(2, 5)))]
+                   for _ in range(8)]
+    pfx_kw = [dict(max_new_tokens=6, temperature=0.8 * (i % 2),
+                   seed=300 + i) for i in range(8)]
+
+    telemetry.reset_for_tests()
+    cold = Engine(params, EngineConfig(**pfx_cfg))
+    cold.warmup()
+    cold_ids = [cold.submit(p, **kw) for p, kw in zip(pfx_prompts, pfx_kw)]
+    cold.run()
+    cold_streams = [cold.requests[i].tokens for i in cold_ids]
+
+    telemetry.reset_for_tests()
+    pfx = Engine(params, EngineConfig(prefix_cache=True, **pfx_cfg))
+    pfx.warmup()
+    pfx_ids = [pfx.submit(p, **kw) for p, kw in zip(pfx_prompts, pfx_kw)]
+    pfx_warm = dict(pfx.trace_counts)
+    pfx.step()
+    if dict(pfx.trace_counts) != pfx_warm:
+        fail(f"prefix-cache step 1 retraced: {dict(pfx.trace_counts)} "
+             f"!= {pfx_warm}")
+    pfx.run()
+    if dict(pfx.trace_counts) != pfx_warm:
+        fail("prefix-cache engine not warm after step 1: "
+             f"{dict(pfx.trace_counts)} vs {pfx_warm}")
+    for i, pid in enumerate(pfx_ids):
+        if pfx.requests[pid].tokens != cold_streams[i]:
+            fail(f"prefix-cache stream {i} diverged: "
+                 f"{pfx.requests[pid].tokens} != {cold_streams[i]} "
+                 "(warm must be byte-identical to cache-cold)")
+    pstats = pfx.stats()["prefix"]
+    if pstats["hits"] != 7 or pstats["misses"] != 1:
+        fail(f"prefix cohort expected 7 hits / 1 miss, got "
+             f"{pstats['hits']} / {pstats['misses']} (second-chance "
+             "re-probe must map what the first stream published)")
+    flat = telemetry.snapshot_flat()
+    if flat.get("serve.prefix.hit_tokens") != 7 * len(shared):
+        fail(f"serve.prefix.hit_tokens="
+             f"{flat.get('serve.prefix.hit_tokens')} != {7 * len(shared)}"
+             " (7 warm streams x 12 shared-prefix tokens)")
+    if flat.get("serve.prefix.shared_blocks", 0) != 7 * 3:
+        fail(f"serve.prefix.shared_blocks="
+             f"{flat.get('serve.prefix.shared_blocks')} != 21")
+    pfx_chunks = int(flat.get("serve.prefill_chunks", 0))
+    # miss stream: 12-token prefix + suffix = 4 chunks; each warm
+    # stream runs ONE suffix chunk
+    if pfx_chunks != 4 + 7:
+        fail(f"prefix cohort ran {pfx_chunks} prefill chunks, expected "
+             "11 (the shared prefix must prefill exactly once)")
+    if pfx.alloc.num_used != 0:
+        fail(f"prefix-cache engine leaked {pfx.alloc.num_used} KV "
+             "blocks (cached prefix blocks must park refcount-0)")
+    if pfx.alloc.num_cached < 3:
+        fail(f"only {pfx.alloc.num_cached} blocks cached after drain; "
+             "the shared prefix (3 blocks) should stay resident")
+    pfx.check_tables()
+
     print(f"serve_smoke: OK (8 streams, {want} tokens, "
           f"hot-swap {len(swap_ms)} replicas "
           f"[{', '.join(f'{m:.0f}ms' for m in swap_ms)}] under load, "
@@ -309,7 +384,9 @@ def main() -> None:
           f"{int(flat.get('serve.router.failovers', 0))} failovers "
           f"byte-identical, speculative k={spec_stats['k']} "
           f"accept={spec_stats['accept_rate']:.2f} "
-          f"({spec_acc} drafts landed), dir={{0}})".format(tmp))
+          f"({spec_acc} drafts landed), prefix cache "
+          f"{pstats['hits']}/8 hits {pfx_chunks} chunks "
+          f"byte-identical, dir={{0}})".format(tmp))
 
 
 if __name__ == "__main__":
