@@ -485,9 +485,10 @@ class ThreadAudit:
             self.track(eng.sched, "queue",
                        f"Scheduler.queue[r{rep.idx}]")
             # alloc._free is REBOUND by slicing in alloc(); the stable
-            # shared structure is the owner map
-            self.track(eng.alloc, "_owner",
-                       f"BlockAllocator._owner[r{rep.idx}]")
+            # shared structure is the refcount map (named _owner before
+            # the round-18 prefix cache made ownership a set)
+            self.track(eng.alloc, "_refs",
+                       f"BlockAllocator._refs[r{rep.idx}]")
 
     # -- patch window ---------------------------------------------------
 

@@ -25,15 +25,29 @@ in, concurrent token streams come out.
   decoding: n-gram/prompt-lookup and small-model drafters feeding the
   engine's replay-exact K-token verify step
   (``MXNET_TPU_SERVE_SPECULATE=1``, docs/serving.md).
+* :mod:`~mxnet_tpu.serve.traffic` — seeded, replay-exact production
+  traffic simulation: diurnal/bursty Poisson arrivals over multi-turn
+  session templates, replayed in virtual time by ``LoadGen``
+  (round 19, docs/serving.md §Traffic simulation & autoscaling).
+* :mod:`~mxnet_tpu.serve.autoscale` — the closed loop: an
+  ``Autoscaler`` polls the telemetry gauges and actuates
+  ``Router.scale_to`` with hysteresis.
 """
-from . import engine, kvcache, router, scheduler, speculate
+from . import autoscale, engine, kvcache, router, scheduler, speculate, \
+    traffic
+from .autoscale import AutoscaleConfig, Autoscaler
 from .engine import Engine, EngineConfig
 from .kvcache import BlockAllocator
 from .router import Router, RouterConfig
 from .scheduler import Request, Scheduler, ServeError
 from .speculate import Drafter, ModelDrafter, NGramDrafter, make_drafter
+from .traffic import LoadGen, Trace, TraceConfig, VirtualClock, \
+    generate_trace
 
 __all__ = ["Engine", "EngineConfig", "BlockAllocator", "Request",
            "Router", "RouterConfig", "Scheduler", "ServeError",
            "Drafter", "ModelDrafter", "NGramDrafter", "make_drafter",
-           "engine", "kvcache", "router", "scheduler", "speculate"]
+           "AutoscaleConfig", "Autoscaler", "LoadGen", "Trace",
+           "TraceConfig", "VirtualClock", "generate_trace",
+           "autoscale", "engine", "kvcache", "router", "scheduler",
+           "speculate", "traffic"]

@@ -1008,17 +1008,25 @@ class Engine:
                 self._prefill(req)
         if self.sched.running:
             self._decode_step()
+        self.publish_load_gauges()
+        telemetry.flight_recorder().record({
+            "kind": "serve", "step": self.step_idx,
+            "active": self.sched.active, "queued": self.sched.queue_depth,
+            "blocks_used": self.alloc.num_used})
+        self.beat += 1
+
+    def publish_load_gauges(self) -> None:
+        """Refresh this engine's load gauges.  ``_step_inner`` calls it
+        per step; the router overwrites the shared names with fleet
+        aggregates every *router* step (``Router._publish_gauges``) so
+        multi-replica readings never depend on which engine stepped
+        last — or whether any engine stepped at all."""
         telemetry.gauge("serve.queue_depth").set(self.sched.queue_depth)
         telemetry.gauge("serve.active_slots").set(self.sched.active)
         telemetry.gauge("serve.kv_blocks_used").set(self.alloc.num_used)
         if self.prefix is not None:
             telemetry.gauge("serve.prefix.cached_frac").set(
                 self.alloc.num_cached / (self.config.num_blocks - 1))
-        telemetry.flight_recorder().record({
-            "kind": "serve", "step": self.step_idx,
-            "active": self.sched.active, "queued": self.sched.queue_depth,
-            "blocks_used": self.alloc.num_used})
-        self.beat += 1
 
     def _chaos_fire(self) -> None:
         """Serve-side chaos points, fired by exact step index (global

@@ -63,6 +63,7 @@ from __future__ import annotations
 import itertools
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence
 
@@ -146,6 +147,11 @@ class RouterRequest:
     state: str = ACTIVE
     finish_reason: Optional[str] = None
     tokens: List[int] = field(default_factory=list)  # synced each step
+    # wall-clock latency observation (always time.monotonic, even when
+    # the router runs on a virtual clock: queueing and compute are
+    # real; only *decisions* are simulated)
+    submit_wall: float = 0.0
+    token_walls: List[float] = field(default_factory=list)
     replica: Optional[Replica] = None
     engine_rid: Optional[int] = None
     failovers: int = 0
@@ -188,6 +194,14 @@ class Router:
         # them replica-by-replica, independently of the target model)
         self._draft_params = draft_params
         self._draft_heads = draft_heads
+        # the spawn recipe: scale_to() builds new replicas from the
+        # same ingredients as construction (chaos looked up by the NEW
+        # replica's index, so a gameday spec targeting replica 0 never
+        # leaks into autoscaled replicas)
+        self._params = params
+        self._engine_config = engine_config
+        self._chaos = dict(chaos)
+        self._chaos_off = off
         self.replicas = [
             Replica(idx=i, engine=Engine(params, engine_config,
                                          chaos=chaos.get(i, off),
@@ -205,6 +219,10 @@ class Router:
         self._seq = itertools.count()
         self._step_ms = 0.0           # EWMA router step wall (shed est.)
         self.recoveries_ms: List[float] = []
+        # rolling window of wall inter-token gaps -> p99 EWMA gauge
+        # (the autoscaler's optional latency signal)
+        self._itl_window: deque = deque(maxlen=256)
+        self._itl_p99_ewma = 0.0
 
     # -- warmup ------------------------------------------------------------
 
@@ -234,7 +252,7 @@ class Router:
                 temperature=float(temperature), top_k=int(top_k),
                 slo_ms=slo_ms, eos_id=eos_id, deadline_ms=deadline_ms,
                 seed=(int(seed) if seed is not None else rid),
-                submit_t=self._clock())
+                submit_t=self._clock(), submit_wall=time.monotonic())
             target = self._pick(rr.prompt)
             reason = self._shed_reason(rr, target)
             if reason is not None:
@@ -355,11 +373,38 @@ class Router:
                 if rep.state == DRAINING and rep.engine.sched.idle():
                     rep.state = DRAINED
                     self._hb.forget(rep.idx)
-            telemetry.gauge("serve.router.replicas_healthy").set(
-                sum(1 for r in self.replicas if r.state == HEALTHY))
+            self._publish_gauges()
             ms = (time.perf_counter() - t0) * 1e3
             self._step_ms = (ms if self._step_ms == 0.0
                              else 0.8 * self._step_ms + 0.2 * ms)
+
+    def _publish_gauges(self) -> None:
+        """Fleet-level load gauges, refreshed EVERY router step — even
+        when every engine is idle, shedding, dead, or parked.  Engines
+        only publish their own (last-writer-wins) gauges when they
+        step, so before round 19 a saturated fleet that stopped
+        admitting work kept advertising its pre-shed load: the
+        autoscaler and any gauge-reading shed logic acted on
+        snapshots.  Pinned by ``tests/test_autoscale.py``."""
+        live = [r for r in self.replicas
+                if r.state in (HEALTHY, DRAINING)]
+        telemetry.gauge("serve.queue_depth").set(
+            sum(r.engine.sched.queue_depth for r in live))
+        telemetry.gauge("serve.active_slots").set(
+            sum(r.engine.sched.active for r in live))
+        telemetry.gauge("serve.kv_blocks_used").set(
+            sum(r.engine.alloc.num_used for r in live))
+        telemetry.gauge("serve.kv_frac").set(
+            max((r.kv_frac() for r in live), default=0.0))
+        if self._itl_window:
+            srt = sorted(self._itl_window)
+            p99 = srt[min(len(srt) - 1, int(0.99 * len(srt)))]
+            self._itl_p99_ewma = (
+                p99 if self._itl_p99_ewma == 0.0
+                else 0.9 * self._itl_p99_ewma + 0.1 * p99)
+        telemetry.gauge("serve.itl_p99_ewma_ms").set(self._itl_p99_ewma)
+        telemetry.gauge("serve.router.replicas_healthy").set(
+            sum(1 for r in self.replicas if r.state == HEALTHY))
 
     def _sync(self, now: float) -> None:
         """Pull every in-flight request's tokens into the router's own
@@ -377,7 +422,12 @@ class Router:
                 continue
             fresh = ereq.tokens[len(rr.tokens):]
             if fresh:
+                wall = time.monotonic()
+                if rr.token_walls:
+                    self._itl_window.append(
+                        (wall - rr.token_walls[-1]) * 1e3)
                 rr.tokens.extend(fresh)
+                rr.token_walls.extend([wall] * len(fresh))
                 if rr.recovering_since is not None:
                     ms = (now - rr.recovering_since) * 1e3
                     rr.recovering_since = None
@@ -478,6 +528,97 @@ class Router:
                     slo_ms=rr.slo_ms, eos_id=rr.eos_id, seed=rr.seed,
                     deadline_ms=rr.deadline_ms, submit_t=rr.submit_t)
                 rr.replica = target
+
+    def undrain(self, idx: int) -> None:
+        """Reverse of :meth:`drain`: reactivate a DRAINING/DRAINED
+        replica.  A parked replica keeps its live engine — KV pool,
+        prefix cache, and AOT programs intact — so reactivation is a
+        state flip plus a heartbeat re-arm: **zero retraces** (pinned
+        by the trace-counts test in ``tests/test_autoscale.py``).
+        Dead replicas cannot undrain; their engine state is gone."""
+        with self._lock:
+            if not 0 <= idx < len(self.replicas):
+                raise MXNetError(f"undrain: no replica {idx} "
+                                 f"(fleet size {len(self.replicas)})")
+            rep = self.replicas[idx]
+            if rep.state not in (DRAINING, DRAINED):
+                raise MXNetError(
+                    f"replica {idx} is {rep.state}; only a draining or "
+                    "drained replica undrains")
+            rep.state = HEALTHY
+            rep.death_cause = None
+            self._hb.beat(rep.idx, now=self._clock())
+            telemetry.counter("serve.router.undrains").inc()
+
+    # -- fleet sizing (the autoscaler's actuator) --------------------------
+
+    def healthy_count(self) -> int:
+        with self._lock:
+            return sum(1 for r in self.replicas if r.state == HEALTHY)
+
+    def scale_to(self, n: int, *, warm: bool = True) -> Dict[str, Any]:
+        """Actuate fleet size toward ``n`` healthy replicas
+        (docs/serving.md §Traffic simulation & autoscaling).
+
+        Scale-UP reactivates parked (DRAINING/DRAINED) replicas first
+        — their warm engines cost zero retraces — then
+        spawn-warmup-attaches brand-new replicas: the engine is built
+        and warmed *before* it joins the table, so ``_pick`` never
+        routes to a cold replica (warmup is compile-cache-cheap after
+        replica 0 — same fingerprint, same avals).  Scale-DOWN drains
+        the least-loaded healthy replicas (their drains finish
+        fastest; ties prefer the newest index, keeping the original
+        fleet stable); they park as DRAINED via the normal step()
+        retirement and are first back on the next ramp.  Scale-down is
+        asynchronous: the healthy count drops immediately (``_pick``
+        skips DRAINING), the engines park once in-flight work ends."""
+        n = int(n)
+        if n < 1:
+            raise MXNetError(f"scale_to: target must be >= 1, got {n}")
+        with self._lock:
+            healthy = [r for r in self.replicas if r.state == HEALTHY]
+            out: Dict[str, Any] = {
+                "target": n, "healthy_before": len(healthy),
+                "reactivated": [], "spawned": [], "draining": []}
+            deficit = n - len(healthy)
+            if deficit > 0:
+                parked = [r for r in self.replicas
+                          if r.state in (DRAINING, DRAINED)]
+                for rep in parked[:deficit]:
+                    self.undrain(rep.idx)
+                    out["reactivated"].append(rep.idx)
+                for _ in range(deficit - len(out["reactivated"])):
+                    out["spawned"].append(self._spawn(warm=warm).idx)
+            elif deficit < 0:
+                victims = sorted(
+                    healthy, key=lambda r: (r.load, -r.idx))[:-deficit]
+                for rep in victims:
+                    self.drain(rep.idx)
+                    out["draining"].append(rep.idx)
+            if (out["reactivated"] or out["spawned"]
+                    or out["draining"]):
+                telemetry.flight_recorder().record({
+                    "kind": "serve.scale", "target": n,
+                    "reactivated": out["reactivated"],
+                    "spawned": out["spawned"],
+                    "draining": out["draining"]})
+            return out
+
+    def _spawn(self, warm: bool = True) -> Replica:
+        """Build, warm, and attach one new replica (callers hold
+        ``_lock``)."""
+        idx = len(self.replicas)
+        eng = Engine(self._params, self._engine_config,
+                     chaos=self._chaos.get(idx, self._chaos_off),
+                     draft_params=self._draft_params,
+                     draft_heads=self._draft_heads)
+        if warm:
+            eng.warmup()
+        rep = Replica(idx=idx, engine=eng)
+        self.replicas.append(rep)
+        self._hb.beat(idx, now=self._clock())
+        telemetry.counter("serve.router.spawns").inc()
+        return rep
 
     # -- rolling weight swap -----------------------------------------------
 
@@ -689,4 +830,5 @@ class Router:
                              for rr in self._requests.values()),
             "recoveries_ms": list(self.recoveries_ms),
             "step_ms_ewma": self._step_ms,
+            "itl_p99_ewma_ms": self._itl_p99_ewma,
         }

@@ -1,0 +1,442 @@
+"""Closed-loop autoscaling (mxnet_tpu/serve/autoscale.py) and the
+router fleet-sizing surface it actuates (``Router.scale_to`` /
+``undrain``), docs/serving.md §Traffic simulation & autoscaling.
+
+Policy units run against a fake router with hand-set gauges and a
+fake clock — no engines, no sleeps:
+
+* breach streaks: a single spiky sample never scales
+  (``breach_polls``); sustained pressure does;
+* hysteresis: a signal wandering inside the high/low dead band
+  triggers nothing, and cooldowns block back-to-back actuations — no
+  flapping;
+* min/max clamps, and the floor-repair path (healthy < min heals
+  immediately, bypassing streaks and cooldowns);
+* config validation (watermark separation, min <= max).
+
+Real-fleet tests pin the round-19 router contracts:
+
+* ``scale_to`` spawn-warmup-attach with ZERO post-warmup retraces
+  (the spawned replica warms through the in-process compile cache);
+* scale-down drains the least-loaded replica; scale-up reactivates
+  parked DRAINED replicas (``undrain``) before spawning — warm
+  engines, zero retraces, pinned via ``trace_counts``;
+* the round-19 stale-gauge fix: ``Router.step()`` republishes the
+  fleet-aggregate load gauges every step, even when every engine is
+  idle (previously ``serve.queue_depth`` froze at its last
+  engine-published value under sustained shed).
+"""
+import numpy as np
+import pytest
+
+from mxnet_tpu import telemetry
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.models.transformer import transformer_lm
+from mxnet_tpu.serve import (AutoscaleConfig, Autoscaler, EngineConfig,
+                             Router, RouterConfig)
+from mxnet_tpu.serve.autoscale import autoscaler_from_env
+from mxnet_tpu.serve.router import DRAINED, DRAINING, HEALTHY
+
+V, NL, D, H = 61, 2, 32, 4
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    telemetry.reset_for_tests()
+    yield
+    telemetry.reset_for_tests()
+
+
+def _make_params(seed=0):
+    rng = np.random.RandomState(seed)
+    sym = transformer_lm(vocab_size=V, num_layers=NL, d_model=D, heads=H,
+                         batch_size=1, seq_len=8)
+    shapes, _, _ = sym.infer_shape(data=(1, 8), softmax_label=(1, 8))
+    return {n: (rng.randn(*s) * 0.05).astype(np.float32)
+            for n, s in zip(sym.list_arguments(), shapes)
+            if n not in ("data", "softmax_label")}
+
+
+_PARAMS = _make_params()
+
+_ECFG = dict(heads=H, block_size=4, num_blocks=64, max_batch=4,
+             max_prompt_len=16, max_seq_len=48, prompt_bucket_min=8)
+
+
+# ----------------------------------------------------------------------
+# Policy units: fake router, fake clock, hand-set gauges
+# ----------------------------------------------------------------------
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+class FakeRouter:
+    """Just the surface the autoscaler reads/actuates."""
+
+    def __init__(self, healthy=1):
+        self.healthy = healthy
+        self.calls = []
+
+    def healthy_count(self):
+        return self.healthy
+
+    def scale_to(self, n, **kw):
+        self.calls.append(n)
+        self.healthy = n
+        return {"target": n}
+
+
+def _gauges(queue=0.0, kv=0.0, itl=0.0):
+    telemetry.gauge("serve.queue_depth").set(queue)
+    telemetry.gauge("serve.kv_frac").set(kv)
+    telemetry.gauge("serve.itl_p99_ewma_ms").set(itl)
+
+
+# interval 1s, 2-poll streaks, short cooldowns: every test drives the
+# clock explicitly
+_PCFG = dict(min_replicas=1, max_replicas=4, interval_s=1.0,
+             high_queue=8.0, low_queue=1.0, high_kv_frac=0.85,
+             low_kv_frac=0.5, breach_polls=2, cooldown_up_s=5.0,
+             cooldown_down_s=10.0)
+
+
+def _policy(**over):
+    cfg = dict(_PCFG)
+    cfg.update(over)
+    clock = FakeClock()
+    router = FakeRouter()
+    return router, clock, Autoscaler(router, AutoscaleConfig(**cfg),
+                                     clock=clock)
+
+
+def _tick(asc, clock, dt=1.0):
+    clock.t += dt
+    return asc.poll()
+
+
+class TestPolicy:
+    def test_single_spike_never_scales(self):
+        router, clock, asc = _policy()
+        _gauges(queue=100.0)
+        assert _tick(asc, clock) is None        # streak 1 of 2
+        _gauges(queue=0.0)
+        assert _tick(asc, clock) is None        # spike gone, streak reset
+        _gauges(queue=100.0)
+        assert _tick(asc, clock) is None        # streak 1 again
+        assert router.calls == []
+
+    def test_sustained_breach_scales_up_one_step(self):
+        router, clock, asc = _policy()
+        _gauges(queue=100.0)
+        _tick(asc, clock)
+        ev = _tick(asc, clock)
+        assert ev["direction"] == "up" and ev["target"] == 2
+        assert router.calls == [2]
+
+    def test_kv_pressure_alone_scales_up(self):
+        router, clock, asc = _policy()
+        _gauges(queue=0.0, kv=0.95)
+        _tick(asc, clock)
+        assert _tick(asc, clock)["direction"] == "up"
+
+    def test_latency_watermark_off_by_default(self):
+        # wall-clock signal: must not fire unless explicitly enabled
+        router, clock, asc = _policy()
+        _gauges(itl=10_000.0)
+        for _ in range(4):
+            assert _tick(asc, clock) is None
+        router, clock, asc = _policy(high_itl_ms=500.0)
+        _gauges(itl=10_000.0)
+        _tick(asc, clock)
+        assert _tick(asc, clock)["direction"] == "up"
+
+    def test_dead_band_no_flapping(self):
+        # queue wandering between the watermarks: nothing ever fires
+        router, clock, asc = _policy()
+        router.healthy = 2
+        for q in (4.0, 7.0, 2.0, 5.0, 7.9, 1.1) * 3:
+            _gauges(queue=q * router.healthy)   # per-replica in band
+            assert _tick(asc, clock) is None
+        assert router.calls == []
+
+    def test_cooldown_blocks_back_to_back_ups(self):
+        router, clock, asc = _policy()
+        _gauges(queue=100.0)
+        _tick(asc, clock)
+        assert _tick(asc, clock)["target"] == 2
+        # still breaching: the streak refills, but cooldown_up_s=5 gates
+        assert _tick(asc, clock) is None
+        assert _tick(asc, clock) is None
+        _tick(asc, clock)
+        _tick(asc, clock)
+        ev = _tick(asc, clock)                  # t=+5 since the scale
+        assert ev is not None and ev["target"] == 3
+        assert router.calls == [2, 3]
+
+    def test_scale_down_needs_slack_on_all_signals(self):
+        router, clock, asc = _policy()
+        router.healthy = 3
+        _gauges(queue=0.0, kv=0.7)              # queue slack, KV not
+        for _ in range(4):
+            assert _tick(asc, clock) is None
+        _gauges(queue=0.0, kv=0.1)
+        _tick(asc, clock)
+        ev = _tick(asc, clock)
+        assert ev["direction"] == "down" and ev["target"] == 2
+
+    def test_min_max_clamps(self):
+        router, clock, asc = _policy(max_replicas=2)
+        router.healthy = 2
+        _gauges(queue=100.0)
+        for _ in range(4):
+            assert _tick(asc, clock) is None    # at the ceiling
+        router, clock, asc = _policy()
+        router.healthy = 1
+        _gauges(queue=0.0)
+        for _ in range(4):
+            assert _tick(asc, clock) is None    # at the floor
+        assert router.calls == []
+
+    def test_floor_repair_bypasses_hysteresis(self):
+        router, clock, asc = _policy(min_replicas=2)
+        router.healthy = 2
+        _gauges(queue=3.0)
+        _tick(asc, clock)
+        router.healthy = 0                      # deaths
+        ev = _tick(asc, clock)                  # immediate, no streak
+        assert ev["direction"] == "floor" and ev["target"] == 2
+        # ...and cooldown does not block a second repair
+        router.healthy = 1
+        ev = _tick(asc, clock)
+        assert ev["direction"] == "floor" and ev["target"] == 2
+
+    def test_interval_gates_polls(self):
+        router, clock, asc = _policy()
+        _gauges(queue=100.0)
+        _tick(asc, clock)
+        for _ in range(10):
+            assert asc.poll() is None           # same instant: no-op
+        assert int(telemetry.snapshot_flat()
+                   ["serve.autoscale.polls"]) == 1
+
+    def test_summary_and_telemetry(self):
+        router, clock, asc = _policy()
+        _gauges(queue=100.0)
+        _tick(asc, clock)
+        _tick(asc, clock)
+        _gauges(queue=0.0)
+        clock.t += 20.0
+        _tick(asc, clock)
+        _tick(asc, clock)
+        s = asc.summary()
+        assert s["scale_ups"] == 1 and s["scale_downs"] == 1
+        flat = telemetry.snapshot_flat()
+        assert flat["serve.autoscale.scale_ups"] == 1
+        assert flat["serve.autoscale.scale_downs"] == 1
+        assert "serve.autoscale.replicas" in flat
+
+    def test_config_validation(self):
+        with pytest.raises(MXNetError):
+            AutoscaleConfig(min_replicas=3, max_replicas=2)
+        with pytest.raises(MXNetError):
+            AutoscaleConfig(min_replicas=0)
+        with pytest.raises(MXNetError):
+            AutoscaleConfig(high_queue=2.0, low_queue=2.0)
+        with pytest.raises(MXNetError):
+            AutoscaleConfig(high_kv_frac=0.5, low_kv_frac=0.6)
+
+    def test_from_env_and_gate(self, monkeypatch):
+        router = FakeRouter()
+        monkeypatch.delenv("MXNET_TPU_SERVE_AUTOSCALE", raising=False)
+        assert autoscaler_from_env(router) is None
+        monkeypatch.setenv("MXNET_TPU_SERVE_AUTOSCALE", "1")
+        monkeypatch.setenv("MXNET_TPU_SERVE_AUTOSCALE_MAX", "7")
+        monkeypatch.setenv("MXNET_TPU_SERVE_AUTOSCALE_HIGH_QUEUE", "5.5")
+        asc = autoscaler_from_env(router)
+        assert asc is not None
+        assert asc.config.max_replicas == 7
+        assert asc.config.high_queue == 5.5
+
+
+# ----------------------------------------------------------------------
+# Real fleet: scale_to / undrain / gauge freshness
+# ----------------------------------------------------------------------
+
+def _fleet(replicas=1, **rover):
+    rcfg = dict(replicas=replicas, heartbeat_timeout_ms=60_000.0)
+    rcfg.update(rover)
+    router = Router(_PARAMS, EngineConfig(**_ECFG),
+                    RouterConfig(**rcfg))
+    router.warmup()
+    return router
+
+
+def _run_all(router, n=6, tokens=8):
+    rng = np.random.RandomState(3)
+    rids = [router.submit(list(map(int, rng.randint(1, V, 5))),
+                          max_new_tokens=tokens, temperature=0.0)
+            for _ in range(n)]
+    for _ in range(200):
+        if all(router.request(r).done() for r in rids):
+            break
+        router.step()
+    assert all(router.request(r).done() for r in rids)
+    return rids
+
+
+class TestScaleTo:
+    def test_scale_up_spawns_warm_replica(self):
+        router = _fleet(1)
+        res = router.scale_to(2)
+        assert res == {"target": 2, "healthy_before": 1,
+                       "reactivated": [], "spawned": [1],
+                       "draining": []}
+        assert router.healthy_count() == 2
+        # the round-19 retrace pin: the spawned replica warmed entirely
+        # through the in-process compile cache
+        assert dict(router.replicas[1].engine.trace_counts) == {}
+        _run_all(router)
+        assert dict(router.replicas[1].engine.trace_counts) == {}
+        flat = telemetry.snapshot_flat()
+        assert flat["serve.router.spawns"] == 1
+
+    def test_scale_down_drains_then_parks(self):
+        router = _fleet(2)
+        res = router.scale_to(1)
+        assert res["draining"] == [1] and res["spawned"] == []
+        assert router.replicas[1].state in (DRAINING, DRAINED)
+        for _ in range(3):
+            router.step()               # nothing in flight: retire now
+        assert router.replicas[1].state == DRAINED
+        assert router.healthy_count() == 1
+        _run_all(router)                # survivor still serves
+
+    def test_scale_down_picks_least_loaded(self):
+        router = _fleet(2)
+        rng = np.random.RandomState(5)
+        router.replicas[0].engine.submit(
+            list(map(int, rng.randint(1, V, 5))), max_new_tokens=4)
+        res = router.scale_to(1)
+        assert res["draining"] == [1]
+        assert router.replicas[1].state in (DRAINING, DRAINED)
+        assert router.replicas[0].state == HEALTHY
+
+    def test_scale_up_reactivates_parked_replica(self):
+        router = _fleet(2)
+        router.scale_to(1)
+        for _ in range(3):
+            router.step()
+        assert router.replicas[1].state == DRAINED
+        trace0 = dict(router.replicas[1].engine.trace_counts)
+        res = router.scale_to(2)
+        # satellite (c): a parked replica comes back via undrain — no
+        # spawn, warm engine, zero retraces
+        assert res["reactivated"] == [1] and res["spawned"] == []
+        assert router.replicas[1].state == HEALTHY
+        _run_all(router)
+        assert dict(router.replicas[1].engine.trace_counts) == trace0
+        flat = telemetry.snapshot_flat()
+        assert flat["serve.router.undrains"] == 1
+        assert "serve.router.spawns" not in flat
+
+    def test_undrain_rejects_healthy_and_dead(self):
+        router = _fleet(2)
+        with pytest.raises(MXNetError):
+            router.undrain(0)           # healthy: nothing to undo
+        with pytest.raises(MXNetError):
+            router.undrain(99)
+
+    def test_scale_to_noop_and_validation(self):
+        router = _fleet(2)
+        res = router.scale_to(2)
+        assert res["spawned"] == [] and res["draining"] == []
+        with pytest.raises(MXNetError):
+            router.scale_to(0)
+
+    def test_closed_loop_on_real_fleet(self):
+        # autoscaler + real router: breach the queue watermark, watch
+        # it actuate a real spawn
+        router = _fleet(1, shed_queue_depth=50)
+        clock = FakeClock()
+        asc = Autoscaler(router, AutoscaleConfig(
+            min_replicas=1, max_replicas=2, interval_s=1.0,
+            high_queue=2.0, low_queue=0.5, breach_polls=2,
+            cooldown_up_s=3.0, cooldown_down_s=3.0), clock=clock)
+        rng = np.random.RandomState(9)
+        rids = [router.submit(list(map(int, rng.randint(1, V, 5))),
+                              max_new_tokens=6, temperature=0.0)
+                for _ in range(10)]
+        router.step()                   # publishes queue_depth > 2
+        clock.t += 1.0
+        asc.poll()
+        clock.t += 1.0
+        ev = asc.poll()
+        assert ev is not None and ev["direction"] == "up"
+        assert router.healthy_count() == 2
+        for _ in range(200):
+            if all(router.request(r).done() for r in rids):
+                break
+            router.step()
+        assert all(router.request(r).state == "finished" for r in rids)
+
+
+class TestGaugeFreshness:
+    def test_router_step_refreshes_load_gauges(self):
+        # satellite (b): the fleet gauges must track every router
+        # step, not just engine steps.  Submit enough to queue, then
+        # watch the gauges move DOWN as the queue drains — and reach
+        # zero on an idle fleet
+        router = _fleet(1, shed_queue_depth=50)
+        rng = np.random.RandomState(4)
+        rids = [router.submit(list(map(int, rng.randint(1, V, 5))),
+                              max_new_tokens=4, temperature=0.0)
+                for _ in range(10)]
+        router.step()
+        flat = telemetry.snapshot_flat()
+        assert flat["serve.queue_depth"] > 0
+        assert flat["serve.kv_blocks_used"] > 0
+        assert "serve.kv_frac" in flat
+        for _ in range(200):
+            if all(router.request(r).done() for r in rids):
+                break
+            router.step()
+        router.step()                   # idle fleet: one more step
+        flat = telemetry.snapshot_flat()
+        assert flat["serve.queue_depth"] == 0
+        assert flat["serve.kv_blocks_used"] == 0
+        assert flat["serve.kv_frac"] == 0
+
+    def test_gauges_fresh_under_sustained_shed(self):
+        # the round-19 bug: under sustained shed the engines never
+        # step, so the gauges froze at their last engine-published
+        # value and the autoscaler read phantom load forever.  Fill
+        # the queue, shed a wave, drain, and check the gauges land at
+        # zero even though the shed requests never reached an engine.
+        router = _fleet(1, shed_queue_depth=4)
+        rng = np.random.RandomState(6)
+        rids = [router.submit(list(map(int, rng.randint(1, V, 5))),
+                              max_new_tokens=4, temperature=0.0)
+                for _ in range(12)]
+        shed = [r for r in rids
+                if router.request(r).finish_reason == "shed"]
+        assert shed, "shed_queue_depth=4 must shed part of the wave"
+        for _ in range(200):
+            if all(router.request(r).done() for r in rids):
+                break
+            router.step()
+        router.step()
+        flat = telemetry.snapshot_flat()
+        assert flat["serve.queue_depth"] == 0
+        assert flat["serve.kv_blocks_used"] == 0
+
+    def test_itl_ewma_gauge_publishes(self):
+        router = _fleet(1)
+        _run_all(router, n=3, tokens=6)
+        flat = telemetry.snapshot_flat()
+        assert flat.get("serve.itl_p99_ewma_ms", 0.0) > 0.0
+        assert router.stats()["itl_p99_ewma_ms"] > 0.0

@@ -1,0 +1,286 @@
+"""Traffic simulation (mxnet_tpu/serve/traffic.py, docs/serving.md
+§Traffic simulation & autoscaling).
+
+The round-19 contracts under test:
+
+* **same-seed byte-identity**: ``generate_trace`` with the same config
+  serializes (``Trace.to_jsonl()``) byte-identically — the schedule,
+  token contents, think times, and per-request seeds are a pure
+  function of the seed — and a different seed diverges;
+* **shape sanity**: power-law lengths respect their bounds and are
+  genuinely heavy-tailed; the diurnal curve concentrates arrivals
+  around the peak; burst episodes multiply the local rate; amplitude 0
+  degenerates to a flat Poisson process;
+* **per-request seeds** come from (trace seed, session, turn) identity,
+  never arrival order;
+* :class:`VirtualClock` is monotonic and rejects negative advances;
+* **virtual-time replay**: the canonical machinery drives a real
+  engine fleet in virtual time, completes every turn, chains
+  multi-turn context (turn k+1's prompt extends turn k's reply), and
+  two runs of the same trace produce byte-identical token streams.
+"""
+import math
+
+import numpy as np
+import pytest
+
+from mxnet_tpu import telemetry
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.models.transformer import transformer_lm
+from mxnet_tpu.serve import (EngineConfig, LoadGen, Router, RouterConfig,
+                             TraceConfig, VirtualClock, generate_trace)
+from mxnet_tpu.serve.traffic import _power_law, request_seed
+
+V, NL, D, H = 61, 2, 32, 4
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    telemetry.reset_for_tests()
+    yield
+    telemetry.reset_for_tests()
+
+
+def _make_params(seed=0):
+    rng = np.random.RandomState(seed)
+    sym = transformer_lm(vocab_size=V, num_layers=NL, d_model=D, heads=H,
+                         batch_size=1, seq_len=8)
+    shapes, _, _ = sym.infer_shape(data=(1, 8), softmax_label=(1, 8))
+    return {n: (rng.randn(*s) * 0.05).astype(np.float32)
+            for n, s in zip(sym.list_arguments(), shapes)
+            if n not in ("data", "softmax_label")}
+
+
+_PARAMS = _make_params()
+
+# a busy minute: enough sessions to exercise multi-turn + bursts but
+# fast enough for CI
+_TCFG = dict(duration_s=60.0, base_rate=1.0, diurnal_period_s=60.0,
+             burst_hazard_per_s=1.0 / 30.0, burst_duration_s=8.0,
+             burst_multiplier=2.0, vocab=V, sys_prompt_min=6,
+             sys_prompt_max=10, max_turns=3, prompt_min=4, prompt_max=16,
+             output_min=4, output_max=10, context_budget=48,
+             think_min_s=1.0, think_max_s=5.0)
+
+
+# ----------------------------------------------------------------------
+# Determinism
+# ----------------------------------------------------------------------
+
+class TestDeterminism:
+    def test_same_seed_byte_identical(self):
+        a = generate_trace(TraceConfig(seed=3, **_TCFG))
+        b = generate_trace(TraceConfig(seed=3, **_TCFG))
+        assert a.to_jsonl() == b.to_jsonl()
+        assert a.arrival_schedule() == b.arrival_schedule()
+
+    def test_different_seed_diverges(self):
+        a = generate_trace(TraceConfig(seed=3, **_TCFG))
+        b = generate_trace(TraceConfig(seed=4, **_TCFG))
+        assert a.to_jsonl() != b.to_jsonl()
+
+    def test_request_seed_is_identity_derived(self):
+        # folded from (trace seed, sid, turn) only — arrival order,
+        # placement, and failover can never perturb it
+        assert request_seed(0, 5, 1) == request_seed(0, 5, 1)
+        assert request_seed(0, 5, 1) != request_seed(0, 5, 2)
+        assert request_seed(0, 5, 1) != request_seed(0, 6, 1)
+        assert request_seed(1, 5, 1) != request_seed(0, 5, 1)
+        for s in (0, 1, 99):
+            assert 0 <= request_seed(s, 0, 0) < 2 ** 31
+
+    def test_trace_seeds_match_identity_fold(self):
+        tr = generate_trace(TraceConfig(seed=7, **_TCFG))
+        for sess in tr.sessions[:20]:
+            for k, turn in enumerate(sess.turns):
+                assert turn.seed == request_seed(7, sess.sid, k)
+
+    def test_env_seed(self, monkeypatch):
+        monkeypatch.setenv("MXNET_TPU_SERVE_TRACE_SEED", "42")
+        assert TraceConfig.from_env().seed == 42
+        assert TraceConfig.from_env(seed=5).seed == 5  # kwarg wins
+
+
+# ----------------------------------------------------------------------
+# Shape
+# ----------------------------------------------------------------------
+
+class TestShape:
+    def test_power_law_bounds_and_tail(self):
+        rng = np.random.RandomState(0)
+        xs = [_power_law(rng, 1.5, 4, 64) for _ in range(4000)]
+        assert min(xs) >= 4 and max(xs) <= 64
+        # heavy tail: the minimum dominates, but big draws exist
+        assert np.mean([x == 4 for x in xs]) > 0.25
+        assert np.mean([x >= 32 for x in xs]) > 0.01
+        assert max(xs) > 48
+
+    def test_lengths_respect_bounds(self):
+        tr = generate_trace(TraceConfig(seed=1, **_TCFG))
+        for sess in tr.sessions:
+            for k, t in enumerate(sess.turns):
+                if k > 0:       # turn 0 may be clamped to the budget
+                    assert len(t.user_tokens) >= _TCFG["prompt_min"]
+                assert len(t.user_tokens) <= _TCFG["prompt_max"]
+                assert (_TCFG["output_min"] <= t.max_new_tokens
+                        <= _TCFG["output_max"])
+                assert (_TCFG["think_min_s"] <= t.think_s
+                        <= _TCFG["think_max_s"])
+                assert all(0 < tok < V for tok in t.user_tokens)
+
+    def test_diurnal_concentrates_arrivals(self):
+        # phase -pi/2: trough at t=0, peak at mid-trace
+        cfg = TraceConfig(seed=0, duration_s=400.0, base_rate=1.0,
+                          diurnal_amplitude=0.9, diurnal_period_s=400.0,
+                          burst_hazard_per_s=0.0, vocab=V)
+        tr = generate_trace(cfg)
+        t0s = [s.t0 for s in tr.sessions]
+        mid = sum(1 for t in t0s if 100.0 <= t < 300.0)
+        edge = len(t0s) - mid
+        assert mid > 2 * edge, (mid, edge)
+
+    def test_flat_when_amplitude_zero(self):
+        cfg = TraceConfig(seed=0, duration_s=400.0, base_rate=1.0,
+                          diurnal_amplitude=0.0,
+                          burst_hazard_per_s=0.0, vocab=V)
+        tr = generate_trace(cfg)
+        t0s = [s.t0 for s in tr.sessions]
+        halves = (sum(1 for t in t0s if t < 200.0),
+                  sum(1 for t in t0s if t >= 200.0))
+        assert abs(halves[0] - halves[1]) < 0.35 * len(t0s)
+
+    def test_bursts_multiply_local_rate(self):
+        base = dict(duration_s=600.0, base_rate=1.0,
+                    diurnal_amplitude=0.0, vocab=V)
+        quiet = generate_trace(TraceConfig(
+            seed=5, burst_hazard_per_s=0.0, **base))
+        bursty = generate_trace(TraceConfig(
+            seed=5, burst_hazard_per_s=1.0 / 100.0,
+            burst_duration_s=30.0, burst_multiplier=4.0, **base))
+        assert len(bursty.burst_episodes) >= 1
+        for a, b in bursty.burst_episodes:
+            assert 0.0 <= a < b <= 600.0
+        n_in = sum(1 for s in bursty.sessions
+                   if any(a <= s.t0 < b
+                          for a, b in bursty.burst_episodes))
+        covered = sum(b - a for a, b in bursty.burst_episodes)
+        frac_time = covered / 600.0
+        frac_arrivals = n_in / max(1, len(bursty.sessions))
+        # inside an episode the rate is 4x: the arrival share must
+        # exceed the time share by a clear margin
+        assert frac_arrivals > 1.5 * frac_time, \
+            (frac_arrivals, frac_time)
+        assert len(quiet.sessions) < len(bursty.sessions)
+
+    def test_context_budget_bounds_session(self):
+        tr = generate_trace(TraceConfig(seed=2, **_TCFG))
+        for sess in tr.sessions:
+            sys_len = len(tr.templates[sess.template])
+            tot = sys_len + sum(len(t.user_tokens) + t.max_new_tokens
+                                for t in sess.turns)
+            assert tot <= _TCFG["context_budget"], (sess.sid, tot)
+
+    def test_amplitude_validated(self):
+        with pytest.raises(MXNetError):
+            generate_trace(TraceConfig(diurnal_amplitude=1.5, vocab=V))
+
+    def test_stats_and_jsonl_roundtrip_fields(self):
+        import json
+        tr = generate_trace(TraceConfig(seed=1, **_TCFG))
+        st = tr.stats()
+        assert st["requests"] == tr.n_requests
+        assert st["sessions"] == len(tr.sessions)
+        lines = tr.to_jsonl().splitlines()
+        kinds = [json.loads(ln)["kind"] for ln in lines]
+        assert kinds[0] == "trace_config"
+        assert kinds.count("template") == tr.config.n_templates
+        assert kinds.count("session") == len(tr.sessions)
+
+
+# ----------------------------------------------------------------------
+# Virtual clock
+# ----------------------------------------------------------------------
+
+class TestVirtualClock:
+    def test_monotonic_and_callable(self):
+        c = VirtualClock(10.0)
+        assert c() == 10.0 and c.now() == 10.0
+        assert c.advance(2.5) == 12.5
+        assert c.advance_to(20.0) == 20.0
+        assert c.advance_to(5.0) == 20.0     # never rewinds
+
+    def test_negative_advance_rejected(self):
+        with pytest.raises(MXNetError):
+            VirtualClock().advance(-1.0)
+
+
+# ----------------------------------------------------------------------
+# Replay against a real fleet
+# ----------------------------------------------------------------------
+
+_REPLAY_CFG = dict(duration_s=30.0, base_rate=0.8,
+                   diurnal_period_s=30.0, burst_hazard_per_s=0.0,
+                   vocab=V, sys_prompt_min=4, sys_prompt_max=6,
+                   max_turns=3, turn_continue_p=0.3, prompt_min=4,
+                   prompt_max=8, output_min=4, output_max=8,
+                   context_budget=40, think_min_s=1.0, think_max_s=3.0)
+
+
+def _replay(seed=11):
+    trace = generate_trace(TraceConfig(seed=seed, **_REPLAY_CFG))
+    clock = VirtualClock()
+    router = Router(_PARAMS,
+                    EngineConfig(heads=H, block_size=4, num_blocks=64,
+                                 max_batch=4, max_queue=32,
+                                 max_prompt_len=32, max_seq_len=64,
+                                 prompt_bucket_min=8, prefill_chunk=8),
+                    RouterConfig(replicas=1,
+                                 heartbeat_timeout_ms=60_000.0),
+                    clock=clock)
+    router.warmup()
+    res = LoadGen(router, trace, clock, step_virtual_s=0.25).run()
+    return trace, router, res
+
+
+class TestReplay:
+    def test_trace_completes_and_chains_turns(self):
+        trace, router, res = _replay()
+        assert trace.n_requests >= 10
+        assert res["requests"] == trace.n_requests
+        assert res["completed"] == trace.n_requests
+        assert res["shed"] == 0 and res["failed"] == 0
+        # multi-turn sessions really chained: some session has turn >= 1
+        assert any(r["turn"] >= 1 for r in res["records"])
+        # wall-clock latency was measured despite virtual-time arrivals
+        assert res["p99_ttft_ms"] is not None
+        assert res["p99_ttft_ms"] > 0.0
+        # virtual duration covers the trace, wall time is way shorter
+        assert res["virtual_s"] >= 30.0
+        assert res["wall_s"] < res["virtual_s"]
+        # clean ledger
+        assert router.replicas[0].engine.alloc.num_used == 0
+        flat = telemetry.snapshot_flat()
+        assert flat["loadgen.submitted"] == trace.n_requests
+        assert flat["loadgen.completed"] == trace.n_requests
+
+    def test_same_trace_replays_byte_identical(self):
+        _, _, a = _replay()
+        telemetry.reset_for_tests()
+        _, _, b = _replay()
+        assert a["stream_keys"] == b["stream_keys"]
+        assert len(a["stream_keys"]) == a["completed"]
+        # submit order identical too
+        sub_a = [(r["sid"], r["turn"]) for r in a["records"]]
+        sub_b = [(r["sid"], r["turn"]) for r in b["records"]]
+        assert sub_a == sub_b
+
+    def test_followup_prompt_extends_context(self):
+        trace, router, res = _replay()
+        by_key = {(r["sid"], r["turn"]): r for r in res["records"]}
+        chained = [s for s in trace.sessions if len(s.turns) >= 2
+                   and (s.sid, 1) in by_key]
+        assert chained, "replay produced no multi-turn session"
+        sess = chained[0]
+        # turn 1 arrived AFTER turn 0 finished plus its think time
+        t0, t1 = by_key[(sess.sid, 0)], by_key[(sess.sid, 1)]
+        assert t1["t_submit"] >= t0["t_submit"] + sess.turns[1].think_s

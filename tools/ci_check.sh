@@ -84,6 +84,21 @@ if [[ "$mode" != "--tests-only" ]]; then
 fi
 
 if [[ "$mode" != "--tests-only" ]]; then
+    # end-to-end gameday: a scaled-down diurnal trace replayed in
+    # virtual time with closed-loop autoscaling (1..3 replicas) and a
+    # mid-ramp replica kill; scale-up AND scale-down must both fire,
+    # the kill must fail over cleanly, zero post-warmup retraces, no
+    # KV leak (docs/serving.md §Traffic simulation & autoscaling)
+    echo "== gameday smoke (tools/gameday_smoke.py) =="
+    python tools/gameday_smoke.py
+    rc=$?
+    if [[ $rc -ne 0 ]]; then
+        echo "ci_check: gameday smoke FAILED (rc=$rc)" >&2
+        exit "$rc"
+    fi
+fi
+
+if [[ "$mode" != "--tests-only" ]]; then
     # end-to-end check of the elastic-training tier: a real launch_local
     # membership cluster loses a SIGKILLed worker mid-run; the trainer
     # must resize 8->4 with zero lost updates and zero retraces

@@ -1,0 +1,170 @@
+#!/usr/bin/env python
+"""CI gameday smoke: traffic simulation + closed-loop autoscaling +
+one mid-ramp replica kill, in seconds (docs/serving.md §Traffic
+simulation & autoscaling).
+
+A scaled-down diurnal trace (2 virtual minutes, one compressed "day")
+replays in virtual time against a 1-replica fleet with the autoscaler
+closed-loop (1..3 replicas).  A ``serve_crash`` chaos point kills the
+first *autoscaled* replica shortly after it attaches — mid-ramp, with
+a healthy survivor — and the smoke asserts the round-19 contract:
+
+1. the run completes (every session drains; no deadlock between the
+   load generator, the autoscaler, and the failover path);
+2. the closed loop moved **both ways**: >= 1 scale-up on the ramp and
+   >= 1 scale-down after the peak;
+3. the kill was survived: >= 1 failover, zero failed requests (crash
+   victims replay on the survivor — the round-12 contract), and the
+   SLO gates hold (bounded shed rate, generous wall-clock TTFT/ITL
+   bars sized for slow CI hosts);
+4. zero post-warmup retraces — autoscaled replicas warm through the
+   in-process compile cache, so spawn-warmup-attach never compiles;
+5. no KV leak: every live replica's block ledger drains to zero;
+6. the loadgen/autoscale telemetry moved (``loadgen.submitted``,
+   ``serve.autoscale.polls``, ``serve.autoscale.replicas``).
+
+Exit 0 on success, 1 with a reason on any failure.  Invoked by
+tools/ci_check.sh after the serve smoke.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault(
+    "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def fail(msg: str) -> None:
+    print(f"gameday_smoke: FAIL: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def main() -> None:
+    import numpy as np
+
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.chaos import ChaosSpec
+    from mxnet_tpu.models.transformer import transformer_lm
+    from mxnet_tpu.serve import (AutoscaleConfig, Autoscaler,
+                                 EngineConfig, LoadGen, Router,
+                                 RouterConfig, TraceConfig, VirtualClock,
+                                 generate_trace)
+
+    telemetry.reset_for_tests()
+
+    V, NL, D, H = 61, 2, 32, 4
+    sym = transformer_lm(vocab_size=V, num_layers=NL, d_model=D, heads=H,
+                         batch_size=1, seq_len=8)
+    shapes, _, _ = sym.infer_shape(data=(1, 8), softmax_label=(1, 8))
+    rng = np.random.RandomState(0)
+    params = {n: (rng.randn(*s) * 0.05).astype(np.float32)
+              for n, s in zip(sym.list_arguments(), shapes)
+              if n not in ("data", "softmax_label")}
+
+    # the canonical trace shrunk to one 2-minute "day": same diurnal
+    # trough -> peak -> trough shape, ~100 requests
+    trace = generate_trace(TraceConfig.from_env(
+        duration_s=120.0, base_rate=1.5, diurnal_period_s=120.0,
+        burst_hazard_per_s=1.0 / 60.0, burst_duration_s=10.0,
+        burst_multiplier=2.0, vocab=V, sys_prompt_min=8,
+        sys_prompt_max=12, max_turns=2, prompt_min=4, prompt_max=12,
+        output_min=4, output_max=10, context_budget=48,
+        think_min_s=1.0, think_max_s=6.0))
+
+    clock = VirtualClock()
+    ecfg = EngineConfig(heads=H, block_size=4, num_blocks=128,
+                        max_batch=4, max_queue=64, max_prompt_len=32,
+                        max_seq_len=64, prompt_bucket_min=8,
+                        prefill_chunk=8)
+    rcfg = RouterConfig(replicas=1, heartbeat_timeout_ms=60_000.0,
+                        shed_queue_depth=16)
+    # the mid-ramp kill: replica 1 is the first replica the autoscaler
+    # spawns; its engine-local step counter starts at attach, so
+    # serve_crash@30 fires shortly into its life — while replica 0 is
+    # healthy, so every in-flight victim fails over
+    chaos = {1: ChaosSpec({"serve_crash": {30}})}
+    router = Router(params, ecfg, rcfg, chaos=chaos, clock=clock)
+    router.warmup()
+    warm0 = [dict(rep.engine.trace_counts) for rep in router.replicas]
+    n0 = len(router.replicas)
+
+    asc = Autoscaler(router, AutoscaleConfig(
+        min_replicas=1, max_replicas=3, interval_s=4.0,
+        high_queue=3.0, low_queue=0.5, breach_polls=2,
+        cooldown_up_s=12.0, cooldown_down_s=30.0), clock=clock)
+
+    res = LoadGen(router, trace, clock, step_virtual_s=0.3,
+                  autoscaler=asc).run()
+    for _ in range(3):
+        router.step()                   # retire finished drains
+
+    ups = asc.summary()["scale_ups"]
+    downs = asc.summary()["scale_downs"]
+    if ups < 1:
+        fail(f"no scale-up observed (events: {asc.events})")
+    if downs < 1:
+        fail(f"no scale-down observed (events: {asc.events})")
+
+    dead = [rep.idx for rep in router.replicas if rep.state == "dead"]
+    if dead != [1]:
+        fail(f"expected exactly replica 1 dead from the chaos kill, "
+             f"got dead={dead} "
+             f"(states: {[r.state for r in router.replicas]})")
+    if res["failovers"] < 1:
+        fail("replica kill produced zero failovers — the chaos point "
+             "did not land mid-stream")
+    if res["failed"] != 0:
+        fail(f"{res['failed']} requests failed; crash victims must "
+             "fail over to the survivor, not error out")
+
+    # SLO gates (wall-clock bars sized for slow shared CI hosts)
+    if res["shed_rate"] > 0.25:
+        fail(f"shed rate {res['shed_rate']:.3f} > 0.25")
+    if res["p99_ttft_ms"] is None or res["p99_ttft_ms"] > 5000.0:
+        fail(f"p99 TTFT {res['p99_ttft_ms']} ms breaches the 5000 ms "
+             "smoke bar")
+    if res["p99_itl_ms"] is None or res["p99_itl_ms"] > 500.0:
+        fail(f"p99 ITL {res['p99_itl_ms']} ms breaches the 500 ms "
+             "smoke bar")
+
+    retraces = 0
+    for rep in router.replicas:
+        total = sum(dict(rep.engine.trace_counts).values())
+        warm = sum(warm0[rep.idx].values()) if rep.idx < n0 else 0
+        retraces += total - warm
+    if retraces != 0:
+        fail(f"{retraces} post-warmup retraces; autoscaled replicas "
+             "must warm through the compile cache")
+
+    leak = sum(rep.engine.alloc.num_used for rep in router.replicas
+               if rep.state != "dead")
+    if leak != 0:
+        fail(f"{leak} KV blocks still allocated after the trace "
+             "drained")
+
+    flat = telemetry.snapshot_flat()
+    if not flat.get("loadgen.submitted"):
+        fail("loadgen.submitted counter never moved")
+    if not flat.get("serve.autoscale.polls"):
+        fail("serve.autoscale.polls counter never moved")
+    if "serve.autoscale.replicas" not in flat:
+        fail("serve.autoscale.replicas gauge missing")
+
+    print(f"gameday_smoke: OK ({res['requests']} requests, "
+          f"{res['completed']} completed, {res['shed']} shed, "
+          f"{res['failovers']} failovers through the replica kill, "
+          f"{ups} ups / {downs} downs "
+          f"{[(e['direction'], round(e['t'], 1), e['target']) for e in asc.events]}, "
+          f"p99 ttft {res['p99_ttft_ms']:.0f}ms itl "
+          f"{res['p99_itl_ms']:.1f}ms, 0 retraces, 0 leaked blocks, "
+          f"{res['virtual_s']:.0f} virtual s in {res['wall_s']:.1f}s "
+          f"wall)")
+
+
+if __name__ == "__main__":
+    main()

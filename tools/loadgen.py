@@ -1,0 +1,170 @@
+#!/usr/bin/env python
+"""Trace-driven load generator CLI (docs/serving.md §Traffic
+simulation & autoscaling).
+
+Three modes over :mod:`mxnet_tpu.serve.traffic`:
+
+* default — generate the trace for the given knobs and print its
+  stats plus an ASCII arrival histogram (the diurnal curve and burst
+  episodes are visible at a glance);
+* ``--out trace.jsonl`` — also write the canonical JSONL
+  serialization (``Trace.to_jsonl()``), the byte-identity surface of
+  the same-seed replay contract: two invocations with the same knobs
+  produce byte-identical files;
+* ``--drive`` — replay the trace in virtual time against a small
+  in-process fleet (tiny transformer-LM, optional closed-loop
+  autoscaling) and print the summary: latency percentiles are real
+  wall-clock measurements, arrivals and scale decisions are virtual.
+
+The canonical 10-minute diurnal trace is the default knob set; the
+gameday bench (``bench.py --serve --trace``) and the CI smoke
+(``tools/gameday_smoke.py``) run scaled variants of the same
+machinery.
+
+Examples::
+
+    python tools/loadgen.py                          # canonical stats
+    python tools/loadgen.py --seed 7 --out /tmp/t.jsonl
+    python tools/loadgen.py --duration 120 --base-rate 1.0 \
+        --drive --autoscale --max-replicas 3
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault(
+    "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _histogram(trace, bins=30, width=50):
+    """ASCII arrival histogram over virtual time."""
+    import numpy as np
+    cfg = trace.config
+    t0s = [s.t0 for s in trace.sessions]
+    counts, edges = np.histogram(
+        t0s, bins=bins, range=(0.0, cfg.duration_s))
+    peak = max(1, int(counts.max()))
+    lines = []
+    for c, lo in zip(counts, edges[:-1]):
+        bar = "#" * int(round(width * c / peak))
+        in_burst = any(a <= lo < b for a, b in trace.burst_episodes)
+        lines.append("%7.1fs |%-*s| %3d%s"
+                     % (lo, width, bar, c, "  *burst" if in_burst else ""))
+    return "\n".join(lines)
+
+
+def _drive(trace, args):
+    """Replay the trace against a tiny in-process fleet."""
+    import numpy as np
+    from mxnet_tpu.models.transformer import transformer_lm
+    from mxnet_tpu.serve import (
+        AutoscaleConfig, Autoscaler, EngineConfig, LoadGen, Router,
+        RouterConfig, VirtualClock)
+
+    V = trace.config.vocab
+    sym = transformer_lm(vocab_size=V, num_layers=2, d_model=32,
+                         heads=4, batch_size=1, seq_len=8)
+    shapes, _, _ = sym.infer_shape(data=(1, 8), softmax_label=(1, 8))
+    rng = np.random.RandomState(0)
+    params = {n: (rng.randn(*s) * 0.05).astype(np.float32)
+              for n, s in zip(sym.list_arguments(), shapes)
+              if n not in ("data", "softmax_label")}
+
+    clock = VirtualClock()
+    ecfg = EngineConfig(heads=4, block_size=16, num_blocks=256,
+                        max_batch=4, max_queue=64, max_prompt_len=64,
+                        max_seq_len=128, prompt_bucket_min=16,
+                        prefill_chunk=16)
+    router = Router(params, ecfg,
+                    RouterConfig(replicas=args.replicas,
+                                 heartbeat_timeout_ms=60_000.0,
+                                 shed_queue_depth=20),
+                    clock=clock)
+    asc = None
+    if args.autoscale:
+        asc = Autoscaler(router, AutoscaleConfig(
+            min_replicas=args.replicas, max_replicas=args.max_replicas,
+            interval_s=4.0, high_queue=3.0, low_queue=0.5,
+            breach_polls=2, cooldown_up_s=12.0, cooldown_down_s=30.0),
+            clock=clock)
+    gen = LoadGen(router, trace, clock,
+                  step_virtual_s=args.step_virtual_s, autoscaler=asc)
+    res = gen.run()
+    out = {k: v for k, v in res.items()
+           if k not in ("streams", "stream_keys", "records")}
+    if asc is not None:
+        out["scale_events"] = [
+            (e["direction"], round(e["t"], 1), e["target"])
+            for e in asc.events]
+    print(json.dumps(out, indent=2, sort_keys=True))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="seeded, replay-exact trace-driven load generator")
+    ap.add_argument("--seed", type=int, default=None,
+                    help="trace seed (default: MXNET_TPU_SERVE_TRACE_"
+                    "SEED, else 0)")
+    ap.add_argument("--duration", type=float, default=600.0,
+                    help="virtual duration in seconds (default 600 — "
+                    "the canonical 10-minute trace)")
+    ap.add_argument("--base-rate", type=float, default=0.3,
+                    help="mean session arrivals / virtual second")
+    ap.add_argument("--amplitude", type=float, default=0.8,
+                    help="diurnal modulation depth in [0, 1]")
+    ap.add_argument("--period", type=float, default=600.0,
+                    help="diurnal period in virtual seconds")
+    ap.add_argument("--burst-hazard", type=float, default=1.0 / 240.0,
+                    help="burst-episode starts / virtual second")
+    ap.add_argument("--burst-mult", type=float, default=2.0,
+                    help="rate multiplier inside a burst episode")
+    ap.add_argument("--vocab", type=int, default=512)
+    ap.add_argument("--out", metavar="PATH",
+                    help="write the canonical JSONL trace here")
+    ap.add_argument("--drive", action="store_true",
+                    help="replay against a tiny in-process fleet")
+    ap.add_argument("--replicas", type=int, default=1,
+                    help="--drive: initial fleet size")
+    ap.add_argument("--autoscale", action="store_true",
+                    help="--drive: close the loop (Autoscaler)")
+    ap.add_argument("--max-replicas", type=int, default=3,
+                    help="--drive --autoscale: fleet ceiling")
+    ap.add_argument("--step-virtual-s", type=float, default=0.3,
+                    help="--drive: virtual seconds per router step")
+    args = ap.parse_args(argv)
+
+    from mxnet_tpu.serve.traffic import TraceConfig, generate_trace
+
+    over = dict(duration_s=args.duration, base_rate=args.base_rate,
+                diurnal_amplitude=args.amplitude,
+                diurnal_period_s=args.period,
+                burst_hazard_per_s=args.burst_hazard,
+                burst_multiplier=args.burst_mult, vocab=args.vocab)
+    if args.seed is not None:
+        over["seed"] = args.seed
+    trace = generate_trace(TraceConfig.from_env(**over))
+
+    print(json.dumps(trace.stats(), indent=2, sort_keys=True))
+    print()
+    print(_histogram(trace))
+    if args.out:
+        text = trace.to_jsonl()
+        with open(args.out, "w") as f:
+            f.write(text)
+        print("\nwrote %d lines (%d bytes) -> %s"
+              % (text.count("\n"), len(text), args.out))
+    if args.drive:
+        print()
+        _drive(trace, args)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -316,6 +316,12 @@ SPEC_ACCEPT_DROP = 0.10
 # replays a fixed shared-prefix trace): a drop means probe/publish
 # behavior changed, not that the host was busy
 PREFIX_HIT_DROP = 0.10
+# trace-gameday shed rate is a deterministic function of the virtual-
+# time schedule, so even small absolute growth means admission or
+# autoscale policy changed; latency on trace rows is wall-clock under a
+# virtual-time driver (jitters >10% run to run) and is gated by each
+# row's own SLO bars instead of a relative diff
+TRACE_SHED_GROWTH = 0.05
 
 
 def diff_serve(path_a, path_b):
@@ -353,7 +359,19 @@ def diff_serve(path_a, path_b):
     retraces, cached TTFT may not grow past ``SERVE_TTFT_GROWTH``
     (beyond the absolute slack), and the hit rate — a
     workload-determined property — may not fall more than
-    ``PREFIX_HIT_DROP`` absolute between reports."""
+    ``PREFIX_HIT_DROP`` absolute between reports.
+
+    Trace rows (``bench.py --serve --trace``, BENCH_r17) gate the
+    round-19 contract on report B: both rows keep their own SLO-bar
+    pass, the autoscaler moved in both directions (>= 1 up and >= 1
+    down), failovers stayed replay-exact (gameday streams
+    byte-identical to clean; same-seed replay byte-identical including
+    the scale schedule and shed set), zero post-warmup retraces, a
+    clean block ledger, and the deterministic shed rate may not grow
+    more than ``TRACE_SHED_GROWTH`` absolute vs report A.  Trace rows
+    are excluded from the relative latency gates above: their TTFT/ITL
+    are wall-clock measurements under a virtual-time driver and jitter
+    beyond the 10% bars run to run."""
     a, b = read_serve(path_a), read_serve(path_b)
     common = [m for m in a if m in b]
     if not common:
@@ -365,6 +383,8 @@ def diff_serve(path_a, path_b):
           "| ttft99 A | ttft99 B | Δ% |")
     print("|---|---|---|---|---|---|---|---|---|---|")
     for metric in common:
+        if " trace " in metric:
+            continue          # gated below on the round-19 contract
         ra, rb = a[metric], b[metric]
         cells = []
         ta = ra.get("value") if ra.get("unit") == "tokens/s" else None
@@ -479,6 +499,44 @@ def diff_serve(path_a, path_b):
                 and ha - hb > PREFIX_HIT_DROP:
             worse.append(f"{metric}: prefix hit rate fell {ha:g} -> "
                          f"{hb:g} (> {PREFIX_HIT_DROP:g} absolute)")
+    for metric, rec in b.items():
+        if " trace " not in metric:
+            continue
+        # the BENCH_r17 contract (docs/serving.md §Traffic simulation
+        # & autoscaling): SLO bars hold, the closed loop moved both
+        # ways, failovers stayed replay-exact, nothing retraced or
+        # leaked, and the deterministic shed rate stayed put
+        if rec.get("pass") is False:
+            worse.append(f"{metric}: trace row failed its own SLO/"
+                         "replay gate in report B")
+        if rec.get("scale_ups", 0) < 1 or rec.get("scale_downs", 0) < 1:
+            worse.append(f"{metric}: autoscaler did not move both ways "
+                         f"({rec.get('scale_ups', 0)} ups / "
+                         f"{rec.get('scale_downs', 0)} downs; need >= 1 "
+                         "each)")
+        if rec.get("streams_identical") is False:
+            worse.append(f"{metric}: gameday streams diverged from the "
+                         "clean run (failover byte-identity broken)")
+        if rec.get("replay_identical") is False:
+            worse.append(f"{metric}: same-seed replay diverged (streams"
+                         "/scale schedule/shed set must be "
+                         "byte-identical)")
+        if rec.get("retraces_after_warmup", 0) != 0:
+            worse.append(f"{metric}: trace scenario retraced "
+                         f"{rec.get('retraces_after_warmup')} programs "
+                         "post-warmup (autoscaled replicas must reuse "
+                         "warm programs)")
+        if rec.get("kv_leak", 0) != 0:
+            worse.append(f"{metric}: {rec.get('kv_leak')} KV blocks "
+                         "leaked (ledger must be clean)")
+        sa = a.get(metric, {}).get("shed_rate")
+        sb = rec.get("shed_rate")
+        if sa is not None and sb is not None \
+                and sb - sa > TRACE_SHED_GROWTH:
+            worse.append(f"{metric}: shed rate grew {sa:g} -> {sb:g} "
+                         f"(> {TRACE_SHED_GROWTH:g} absolute — the "
+                         "trace is deterministic, so admission or "
+                         "autoscale policy changed)")
     for msg in worse:
         print(f"REGRESSED: {msg}", file=sys.stderr)
     return 1 if worse else 0
